@@ -1,0 +1,153 @@
+"""Headline benchmark: FedAvg rounds/sec, 100 clients, CIFAR10-shaped data,
+ResNet-56 (BASELINE.json "metric").
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+``vs_baseline`` compares against the reference implementation's achievable
+round rate on this host: FedML's standalone simulator trains sampled clients
+*serially* in PyTorch (``fedml_api/standalone/fedavg/fedavg_api.py:40-81``),
+so the baseline is (clients_per_round x steps_per_client x torch
+per-batch fwd+bwd time), measured here with a torch ResNet-56 on the same
+shapes (extrapolated from a few timed batches to keep the bench fast).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def build_sim():
+    from fedml_tpu.config import (
+        DataConfig,
+        ExperimentConfig,
+        FedConfig,
+        ModelConfig,
+        TrainConfig,
+    )
+    from fedml_tpu.algorithms.fedavg import FedAvgSim
+    from fedml_tpu.data.loaders import load_dataset
+    from fedml_tpu.models import create_model
+
+    cfg = ExperimentConfig(
+        data=DataConfig(
+            dataset="fake_cifar10",
+            num_clients=100,
+            partition_method="hetero",
+            partition_alpha=0.5,
+            batch_size=32,
+            seed=0,
+        ),
+        model=ModelConfig(
+            name="resnet56", num_classes=10, input_shape=(32, 32, 3)
+        ),
+        train=TrainConfig(lr=0.03, epochs=1),
+        fed=FedConfig(num_rounds=1000, clients_per_round=10, eval_every=10**9),
+        seed=0,
+    )
+    data = load_dataset(cfg.data)
+    model = create_model(cfg.model)
+    return FedAvgSim(model, data, cfg), data
+
+
+def torch_baseline_round_seconds(
+    steps_per_client: int, clients_per_round: int, batch_size: int = 32
+) -> float:
+    """Per-round wall-clock of the reference-style serial torch loop,
+    extrapolated from a few timed ResNet-56 fwd+bwd batches."""
+    import torch
+    import torch.nn as nn
+
+    class Block(nn.Module):
+        def __init__(self, cin, cout, stride):
+            super().__init__()
+            self.c1 = nn.Conv2d(cin, cout, 3, stride, 1, bias=False)
+            self.b1 = nn.BatchNorm2d(cout)
+            self.c2 = nn.Conv2d(cout, cout, 3, 1, 1, bias=False)
+            self.b2 = nn.BatchNorm2d(cout)
+            self.short = (
+                nn.Sequential(
+                    nn.Conv2d(cin, cout, 1, stride, bias=False),
+                    nn.BatchNorm2d(cout),
+                )
+                if (stride != 1 or cin != cout)
+                else nn.Identity()
+            )
+
+        def forward(self, x):
+            y = torch.relu(self.b1(self.c1(x)))
+            y = self.b2(self.c2(y))
+            return torch.relu(y + self.short(x))
+
+    layers = [nn.Conv2d(3, 16, 3, 1, 1, bias=False), nn.BatchNorm2d(16), nn.ReLU()]
+    cin = 16
+    for stage, ch in enumerate((16, 32, 64)):
+        for blk in range(9):  # 6*9+2 = 56
+            layers.append(Block(cin, ch, 2 if (stage > 0 and blk == 0) else 1))
+            cin = ch
+    net = nn.Sequential(
+        *layers, nn.AdaptiveAvgPool2d(1), nn.Flatten(), nn.Linear(64, 10)
+    )
+    opt = torch.optim.SGD(net.parameters(), lr=0.03)
+    lossf = nn.CrossEntropyLoss()
+    x = torch.randn(batch_size, 3, 32, 32)
+    y = torch.randint(0, 10, (batch_size,))
+
+    def step():
+        opt.zero_grad()
+        lossf(net(x), y).backward()
+        opt.step()
+
+    step()  # warmup
+    t0 = time.perf_counter()
+    n_timed = 3
+    for _ in range(n_timed):
+        step()
+    per_batch = (time.perf_counter() - t0) / n_timed
+    return per_batch * steps_per_client * clients_per_round
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rounds", type=int, default=15)
+    ap.add_argument("--skip-torch-baseline", action="store_true")
+    args = ap.parse_args()
+
+    sim, data = build_sim()
+    state = sim.init()
+    # warmup (compile)
+    state, _ = sim.run_round(state)
+    import jax
+
+    jax.block_until_ready(state.variables)
+
+    t0 = time.perf_counter()
+    for _ in range(args.rounds):
+        state, m = sim.run_round(state)
+    jax.block_until_ready(state.variables)
+    dt = time.perf_counter() - t0
+    rps = args.rounds / dt
+
+    vs = float("nan")
+    if not args.skip_torch_baseline:
+        steps_per_client = sim.arrays.max_client_samples // sim.batch_size
+        base_round_s = torch_baseline_round_seconds(steps_per_client, 10)
+        vs = rps * base_round_s  # ratio of round rates
+
+    print(
+        json.dumps(
+            {
+                "metric": "fedavg_rounds_per_sec_100c_cifar10_resnet56",
+                "value": round(rps, 4),
+                "unit": "rounds/sec",
+                "vs_baseline": round(vs, 2) if np.isfinite(vs) else None,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

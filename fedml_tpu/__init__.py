@@ -1,0 +1,35 @@
+"""fedml_tpu — a TPU-native federated-learning framework.
+
+A ground-up JAX/XLA re-design of the capabilities of FedML (reference:
+arj119/FedML). Instead of the reference's actor/observer thread machinery
+(fedml_core/distributed/*), the single-host simulation path is a *compiled
+program*: client states are stacked pytrees, local training is a ``vmap`` /
+``shard_map`` of a jitted local update, and aggregation is a weighted
+pytree reduction (``psum`` across a device mesh).
+
+Layer map (mirrors SURVEY.md §1 of the reference):
+
+- ``fedml_tpu.core``       — message/transport runtime, topology, robustness
+  (reference: ``fedml_core/distributed``)
+- ``fedml_tpu.data``       — partitioners + federated dataset loaders
+  (reference: ``fedml_api/data_preprocessing``)
+- ``fedml_tpu.models``     — flax model zoo
+  (reference: ``fedml_api/model``)
+- ``fedml_tpu.algorithms`` — FL algorithms, compiled-sim and actor-based
+  (reference: ``fedml_api/{standalone,distributed}``)
+- ``fedml_tpu.parallel``   — mesh construction, client/data sharding
+- ``fedml_tpu.ops``        — pallas kernels for hot ops
+- ``fedml_tpu.metrics``    — metric sinks, FID, KD losses
+- ``fedml_tpu.experiments``— CLI entry points
+"""
+
+__version__ = "0.1.0"
+
+from fedml_tpu import config as config
+from fedml_tpu.config import (
+    DataConfig,
+    ExperimentConfig,
+    FedConfig,
+    ModelConfig,
+    TrainConfig,
+)

@@ -1,0 +1,11 @@
+"""FL algorithms.
+
+Compiled-simulation algorithms (the TPU redesign of the reference's
+``fedml_api/standalone`` family) plus actor-based distributed variants
+(redesign of ``fedml_api/distributed``). The compiled path expresses a whole
+federated round as one XLA program: cohort sampling, vmapped local SGD,
+weighted pytree aggregation, and the server update.
+"""
+
+from fedml_tpu.algorithms.base import Task, build_evaluator, build_local_update, make_task
+from fedml_tpu.algorithms.fedavg import FedAvgSim, ServerState

@@ -1,0 +1,328 @@
+"""Task definitions and the compiled client local-update.
+
+The reference's per-task trainers
+(``fedml_api/standalone/fedavg/my_model_trainer_classification.py``,
+``..._nwp.py``, ``..._tag_prediction.py``) become pure loss/metric functions
+here, and ``MyModelTrainer.train`` (epochs x minibatch SGD) becomes a jitted
+``lax.scan`` over steps that is *vmapped across the cohort* — one XLA
+program trains every sampled client in parallel on the MXU.
+
+Padding discipline: every client's index row is padded to ``max_n``; a
+padded batch contributes zero gradient AND zero optimizer-state update
+(updates are gated on the batch containing at least one real sample), so a
+small client's trajectory exactly matches serial training on its real data.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from fedml_tpu.config import TrainConfig
+from fedml_tpu.core import tree as T
+from fedml_tpu.models.base import FedModel
+
+Pytree = Any
+
+
+# ---------------------------------------------------------------------------
+# Tasks (loss + metrics)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Task:
+    """Weighted loss + sufficient-statistics metrics for one task type."""
+
+    name: str
+    # (logits, y, weights[B]) -> scalar mean loss
+    loss: Callable[[jax.Array, jax.Array, jax.Array], jax.Array]
+    # (logits, y, weights[B]) -> dict of SUMS {loss_sum, correct, count}
+    metric_sums: Callable[[jax.Array, jax.Array, jax.Array], dict]
+
+
+def _classification_task() -> Task:
+    def loss(logits, y, w):
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+        return jnp.sum(ce * w) / jnp.maximum(jnp.sum(w), 1.0)
+
+    def sums(logits, y, w):
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+        correct = (jnp.argmax(logits, -1) == y).astype(jnp.float32)
+        return {
+            "loss_sum": jnp.sum(ce * w),
+            "correct": jnp.sum(correct * w),
+            "count": jnp.sum(w),
+            "w_sum": jnp.sum(w),
+        }
+
+    return Task("classification", loss, sums)
+
+
+def _nwp_task() -> Task:
+    """Next-word/char prediction: logits [B,T,V], y [B,T]; token-level
+    accuracy (reference ``my_model_trainer_nwp.py``)."""
+
+    def per_token(logits, y):
+        return optax.softmax_cross_entropy_with_integer_labels(logits, y)
+
+    def loss(logits, y, w):
+        ce = per_token(logits, y)  # [B, T]
+        return jnp.sum(ce * w[:, None]) / jnp.maximum(
+            jnp.sum(w) * y.shape[1], 1.0
+        )
+
+    def sums(logits, y, w):
+        ce = per_token(logits, y)
+        correct = (jnp.argmax(logits, -1) == y).astype(jnp.float32)
+        tokens = jnp.sum(w) * y.shape[1]
+        return {
+            "loss_sum": jnp.sum(ce * w[:, None]),
+            "correct": jnp.sum(correct * w[:, None]),
+            "count": tokens,
+            "w_sum": tokens,
+        }
+
+    return Task("nwp", loss, sums)
+
+
+def _tag_task() -> Task:
+    """Multi-label tag prediction with sigmoid BCE; accuracy = micro
+    precision at threshold 0.5 (reference multilabel path,
+    ``fedml_core/trainer/model_trainer.py:57-112``)."""
+
+    def loss(logits, y, w):
+        bce = optax.sigmoid_binary_cross_entropy(logits, y).mean(-1)
+        return jnp.sum(bce * w) / jnp.maximum(jnp.sum(w), 1.0)
+
+    def sums(logits, y, w):
+        bce = optax.sigmoid_binary_cross_entropy(logits, y).mean(-1)
+        pred = (jax.nn.sigmoid(logits) > 0.5).astype(jnp.float32)
+        tp = jnp.sum(pred * y * w[:, None])
+        predicted = jnp.sum(pred * w[:, None])
+        return {
+            "loss_sum": jnp.sum(bce * w),
+            "correct": tp,  # numerator of micro-precision
+            "count": jnp.maximum(predicted, 1.0),
+            "w_sum": jnp.sum(w),
+        }
+
+    return Task("tag_prediction", loss, sums)
+
+
+def make_task(name: str) -> Task:
+    return {
+        "classification": _classification_task,
+        "nwp": _nwp_task,
+        "tag_prediction": _tag_task,
+    }[name]()
+
+
+# ---------------------------------------------------------------------------
+# Client optimizer
+# ---------------------------------------------------------------------------
+
+
+def make_client_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
+    """Reference client optimizers: SGD(momentum, wd) or Adam(wd, amsgrad)
+    (``my_model_trainer_classification.py`` train())."""
+    chain = []
+    if cfg.clip_norm > 0:
+        chain.append(optax.clip_by_global_norm(cfg.clip_norm))
+    if cfg.optimizer == "sgd":
+        if cfg.weight_decay > 0:
+            chain.append(optax.add_decayed_weights(cfg.weight_decay))
+        chain.append(
+            optax.sgd(cfg.lr, momentum=cfg.momentum if cfg.momentum else None)
+        )
+    elif cfg.optimizer == "adam":
+        chain.append(optax.adamw(cfg.lr, weight_decay=cfg.weight_decay))
+    else:
+        raise ValueError(f"unknown client optimizer: {cfg.optimizer}")
+    return optax.chain(*chain)
+
+
+# ---------------------------------------------------------------------------
+# Local update (the client hot loop, compiled)
+# ---------------------------------------------------------------------------
+
+
+def build_local_update(
+    model: FedModel,
+    task: Task,
+    cfg: TrainConfig,
+    batch_size: int,
+    max_n: int,
+    data_axis: str | None = None,
+    data_axis_size: int = 1,
+):
+    """Build ``local_update(global_vars, idx_row, mask_row, x, y, rng)``.
+
+    Replaces ``MyModelTrainer.train`` (reference
+    ``standalone/fedavg/my_model_trainer_classification.py``): runs
+    ``cfg.epochs`` passes of minibatch SGD over the client's (padded) data,
+    returns ``(new_vars, n_k, train_metric_sums)``.
+
+    ``batch_size`` and ``max_n`` are static; ``max_n`` must be a multiple of
+    ``batch_size`` (the padder guarantees it). The whole function is pure and
+    vmappable over the leading axis of (idx_row, mask_row, rng).
+
+    If ``data_axis`` is set, the function must run inside a ``shard_map``
+    over a mesh axis of that name: each shard consumes a disjoint
+    ``batch_size // data_axis_size`` slice of every batch and gradients are
+    ``psum``-ed — the TPU analog of the reference's intra-silo DDP
+    (``fedavg_cross_silo/DistWorker.py:52-54``, NCCL allreduce per batch).
+    """
+    assert max_n % batch_size == 0, (max_n, batch_size)
+    assert batch_size % data_axis_size == 0, (batch_size, data_axis_size)
+    steps_per_epoch = max_n // batch_size
+    shard_bs = batch_size // data_axis_size
+    opt = make_client_optimizer(cfg)
+
+    def loss_fn(params, static_vars, x_b, y_b, w_b, rng, global_params):
+        """Weighted-SUM loss normalized by the psum-ed weight total, so that
+        psum of per-shard grads equals the exact full-batch gradient even
+        with masked (padded) samples."""
+        variables = {**static_vars, "params": params}
+        logits, new_vars = model.apply_train(variables, x_b, rng)
+        sums = task.metric_sums(logits, y_b, w_b)
+        w_total = sums["w_sum"]
+        if data_axis is not None:
+            w_total = jax.lax.psum(w_total, data_axis)
+        loss = sums["loss_sum"] / jnp.maximum(w_total, 1.0)
+        if cfg.prox_mu > 0:  # FedProx proximal term (fedprox trainer)
+            diff = T.tree_sub(params, global_params)
+            loss = loss + 0.5 * cfg.prox_mu * T.tree_dot(diff, diff) / (
+                data_axis_size
+            )
+        return loss, (new_vars, sums)
+
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+    def local_update(global_vars, idx_row, mask_row, x, y, rng):
+        global_params = global_vars["params"]
+
+        def epoch_body(carry, ekey):
+            variables, opt_state, msums = carry
+            perm = jax.random.permutation(ekey, max_n)
+
+            def step_body(carry2, step):
+                variables, opt_state, msums = carry2
+                offset = step * batch_size
+                if data_axis is not None:
+                    offset = offset + jax.lax.axis_index(data_axis) * shard_bs
+                take = jax.lax.dynamic_slice_in_dim(perm, offset, shard_bs)
+                b_idx = idx_row[take]
+                w_b = mask_row[take]
+                x_b = jnp.take(x, b_idx, axis=0)
+                y_b = jnp.take(y, b_idx, axis=0)
+                skey = jax.random.fold_in(ekey, step)
+                params = variables["params"]
+                static_vars = {
+                    k: v for k, v in variables.items() if k != "params"
+                }
+                (_, (new_vars, sums)), grads = grad_fn(
+                    params, static_vars, x_b, y_b, w_b, skey, global_params
+                )
+                if data_axis is not None:
+                    grads = jax.lax.psum(grads, data_axis)
+                    sums = jax.tree.map(
+                        lambda s: jax.lax.psum(s, data_axis), sums
+                    )
+                    # keep batch_stats consistent across the data axis
+                    # (sync-BN-lite; reference uses SynchronizedBatchNorm
+                    # for fedseg, batchnorm_utils.py:240)
+                    new_vars = {
+                        k: (
+                            jax.lax.pmean(v, data_axis)
+                            if k == "batch_stats"
+                            else v
+                        )
+                        for k, v in new_vars.items()
+                    }
+                updates, new_opt_state = opt.update(
+                    grads, opt_state, params
+                )
+                new_params = optax.apply_updates(params, updates)
+                # gate: a fully-padded batch must be a strict no-op
+                valid = jnp.sum(w_b) > 0
+                sel = lambda n, o: jax.tree.map(
+                    lambda a, b: jnp.where(valid, a, b), n, o
+                )
+                new_variables = {**new_vars, "params": new_params}
+                out_vars = sel(new_variables, variables)
+                out_opt = sel(new_opt_state, opt_state)
+                msums = {k: msums[k] + sums[k] for k in msums}
+                return (out_vars, out_opt, msums), None
+
+            (variables, opt_state, msums), _ = jax.lax.scan(
+                step_body,
+                (variables, opt_state, msums),
+                jnp.arange(steps_per_epoch),
+            )
+            return (variables, opt_state, msums), None
+
+        opt_state = opt.init(global_vars["params"])
+        msums0 = {
+            "loss_sum": jnp.asarray(0.0),
+            "correct": jnp.asarray(0.0),
+            "count": jnp.asarray(0.0),
+            "w_sum": jnp.asarray(0.0),
+        }
+        ekeys = jax.vmap(lambda e: jax.random.fold_in(rng, e))(
+            jnp.arange(cfg.epochs)
+        )
+        (variables, _, msums), _ = jax.lax.scan(
+            epoch_body, (global_vars, opt_state, msums0), ekeys
+        )
+        n_k = jnp.sum(mask_row)
+        return variables, n_k, msums
+
+    return local_update
+
+
+# ---------------------------------------------------------------------------
+# Evaluation
+# ---------------------------------------------------------------------------
+
+
+def build_evaluator(model: FedModel, task: Task, eval_batch: int = 256):
+    """Jitted global-test evaluation: pad to a multiple of ``eval_batch``,
+    scan batches, reduce metric sums (reference
+    ``_local_test_on_all_clients`` / ``test_on_server_for_all_clients``,
+    ``FedAVGAggregator.py:110-164``)."""
+
+    def evaluate(variables, x, y):
+        n = x.shape[0]
+        pad = (-n) % eval_batch
+        xp = jnp.concatenate([x, jnp.zeros((pad,) + x.shape[1:], x.dtype)])
+        yp = jnp.concatenate([y, jnp.zeros((pad,) + y.shape[1:], y.dtype)])
+        w = jnp.concatenate([jnp.ones((n,)), jnp.zeros((pad,))])
+        nb = (n + pad) // eval_batch
+
+        def body(sums, i):
+            sl = lambda a: jax.lax.dynamic_slice_in_dim(
+                a, i * eval_batch, eval_batch
+            )
+            logits = model.apply_eval(variables, sl(xp))
+            s = task.metric_sums(logits, sl(yp), sl(w))
+            return {k: sums[k] + s[k] for k in sums}, None
+
+        sums0 = {
+            "loss_sum": jnp.asarray(0.0),
+            "correct": jnp.asarray(0.0),
+            "count": jnp.asarray(0.0),
+            "w_sum": jnp.asarray(0.0),
+        }
+        sums, _ = jax.lax.scan(body, sums0, jnp.arange(nb))
+        return {
+            "loss": sums["loss_sum"] / jnp.maximum(sums["w_sum"], 1.0),
+            "acc": sums["correct"] / jnp.maximum(sums["count"], 1.0),
+            "count": sums["count"],
+        }
+
+    return jax.jit(evaluate)

@@ -1,0 +1,231 @@
+"""FedAvg family as one compiled round program.
+
+TPU-native redesign of the reference's standalone simulator
+(``fedml_api/standalone/fedavg/fedavg_api.py:40-115``) and the FedOpt /
+FedProx / FedNova / robust-aggregation variants — each reference variant is a
+configuration of the same compiled round:
+
+- client sampling          (``FedAVGAggregator.client_sampling``)
+- vmapped local SGD        (``FedAVGTrainer.train`` x cohort, in parallel)
+- weighted pytree mean     (``FedAVGAggregator.aggregate``)
+- server optimizer step    (``fedopt/FedOptAggregator`` pseudo-gradient)
+- robust preprocessing     (``fedml_core/robustness/robust_aggregation.py``)
+- FedNova tau-normalization(``standalone/fednova/fednova.py:97``)
+
+One ``jax.jit`` round; all state device-resident; the python loop only
+sequences rounds and reads metrics.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from fedml_tpu.config import ExperimentConfig
+from fedml_tpu.core import random as R
+from fedml_tpu.core import robust, tree as T
+from fedml_tpu.data.federated import FederatedArrays, FederatedData
+from fedml_tpu.algorithms.base import (
+    build_evaluator,
+    build_local_update,
+    make_task,
+)
+from fedml_tpu.models.base import FedModel
+
+Pytree = Any
+
+
+class ServerState(NamedTuple):
+    variables: Pytree  # full model variables (params [+ batch_stats])
+    opt_state: Any  # server optimizer state
+    momentum: Pytree  # FedNova global momentum buffer
+    round: jax.Array  # int32
+
+
+def make_server_optimizer(name: str, lr: float, momentum: float):
+    """Server optimizers (reference ``fedopt/optrepo.py:7`` reflection over
+    torch optimizers; ``sgd`` with lr=1 and no momentum == plain FedAvg)."""
+    if name == "sgd":
+        return optax.sgd(lr, momentum=momentum if momentum else None)
+    if name == "adam":
+        return optax.adam(lr)
+    if name == "adagrad":
+        return optax.adagrad(lr)
+    if name == "yogi":
+        return optax.yogi(lr)
+    raise ValueError(f"unknown server optimizer: {name}")
+
+
+class FedAvgSim:
+    """Compiled federated simulation on one chip (see
+    :mod:`fedml_tpu.parallel` for the mesh-sharded version)."""
+
+    def __init__(
+        self,
+        model: FedModel,
+        data: FederatedData,
+        cfg: ExperimentConfig,
+    ):
+        self.model = model
+        self.cfg = cfg
+        self.task = make_task(data.task)
+        pad = 1 if cfg.data.full_batch else cfg.data.batch_size
+        self.arrays: FederatedArrays = data.to_arrays(pad_multiple=pad)
+        max_n = self.arrays.max_client_samples
+        self.batch_size = max_n if cfg.data.full_batch else min(
+            cfg.data.batch_size, max_n
+        )
+        self.local_update = build_local_update(
+            model, self.task, cfg.train, self.batch_size, max_n
+        )
+        self.evaluator = build_evaluator(model, self.task)
+        self.root_key = jax.random.key(cfg.seed)
+        self._round_fn = jax.jit(self._round, donate_argnums=(0,))
+
+    # -- initialization ----------------------------------------------------
+    def init(self) -> ServerState:
+        variables = self.model.init(
+            jax.random.fold_in(self.root_key, 0x7FFFFFFF)
+        )
+        opt = make_server_optimizer(
+            self.cfg.fed.server_optimizer,
+            self.cfg.fed.server_lr,
+            self.cfg.fed.server_momentum,
+        )
+        return ServerState(
+            variables=variables,
+            opt_state=opt.init(variables["params"]),
+            momentum=T.tree_zeros_like(variables["params"]),
+            round=jnp.asarray(0, jnp.int32),
+        )
+
+    # -- one round ---------------------------------------------------------
+    def _round(self, state: ServerState, arrays: FederatedArrays):
+        cfg = self.cfg.fed
+        rkey = R.round_key(self.root_key, state.round)
+        cohort = R.sample_clients(
+            jax.random.fold_in(rkey, 0),
+            arrays.num_clients,
+            cfg.clients_per_round,
+        )
+        ckeys = jax.vmap(lambda c: R.client_key(rkey, c))(cohort)
+        idx_rows = arrays.idx[cohort]
+        mask_rows = arrays.mask[cohort]
+
+        stacked_vars, n_k, msums = jax.vmap(
+            self.local_update, in_axes=(None, 0, 0, None, None, 0)
+        )(state.variables, idx_rows, mask_rows, arrays.x, arrays.y, ckeys)
+
+        new_state = self._server_update(state, stacked_vars, n_k, rkey)
+        train_metrics = {
+            "train_loss": msums["loss_sum"].sum()
+            / jnp.maximum(msums["count"].sum(), 1.0),
+            "train_acc": msums["correct"].sum()
+            / jnp.maximum(msums["count"].sum(), 1.0),
+        }
+        return new_state, train_metrics
+
+    def _server_update(
+        self,
+        state: ServerState,
+        stacked_vars: Pytree,
+        n_k: jax.Array,
+        rkey: jax.Array,
+    ) -> ServerState:
+        cfg = self.cfg.fed
+        global_params = state.variables["params"]
+        stacked_params = {"params": stacked_vars["params"]}["params"]
+        # client deltas (w_k - w_global)
+        deltas = jax.tree.map(
+            lambda s, g: s - g[None], stacked_params, global_params
+        )
+
+        if cfg.robust_norm_clip > 0:
+            deltas = robust.clip_deltas_by_norm(deltas, cfg.robust_norm_clip)
+
+        if self.cfg.fed.algorithm == "fednova":
+            # tau_k = true local steps; normalize each delta, rescale by
+            # tau_eff (reference fednova.py aggregate, tau-normalization)
+            steps_pe = self.arrays.max_client_samples // self.batch_size
+            tau = (
+                jnp.ceil(n_k / self.batch_size).clip(1, steps_pe)
+                * self.cfg.train.epochs
+            )
+            p_k = n_k / jnp.maximum(n_k.sum(), 1.0)
+            tau_eff = jnp.sum(p_k * tau)
+            d = jax.tree.map(
+                lambda x: x / tau.reshape((-1,) + (1,) * (x.ndim - 1)), deltas
+            )
+            agg_delta = T.tree_scale(T.tree_weighted_mean(d, n_k), tau_eff)
+        elif cfg.robust_method == "median":
+            agg_delta = robust.coordinate_median(deltas)
+        elif cfg.robust_method == "trimmed_mean":
+            agg_delta = robust.trimmed_mean(deltas)
+        else:
+            agg_delta = T.tree_weighted_mean(deltas, n_k)
+
+        if cfg.robust_noise_stddev > 0:
+            agg_delta = robust.add_gaussian_noise(
+                agg_delta, cfg.robust_noise_stddev, jax.random.fold_in(rkey, 1)
+            )
+
+        # server optimizer on the pseudo-gradient -agg_delta
+        opt = make_server_optimizer(
+            cfg.server_optimizer, cfg.server_lr, cfg.server_momentum
+        )
+        pseudo_grad = T.tree_scale(agg_delta, -1.0)
+        updates, new_opt_state = opt.update(
+            pseudo_grad, state.opt_state, global_params
+        )
+        new_params = optax.apply_updates(global_params, updates)
+
+        # non-param collections (batch_stats): plain weighted mean, like the
+        # reference's full-state_dict averaging (FedAVGAggregator.py:73-81)
+        other = {
+            k: T.tree_weighted_mean(v, n_k)
+            for k, v in stacked_vars.items()
+            if k != "params"
+        }
+        new_variables = {**other, "params": new_params}
+        return ServerState(
+            variables=new_variables,
+            opt_state=new_opt_state,
+            momentum=state.momentum,
+            round=state.round + 1,
+        )
+
+    # -- public API --------------------------------------------------------
+    def run_round(self, state: ServerState):
+        return self._round_fn(state, self.arrays)
+
+    def evaluate_global(self, state: ServerState) -> dict:
+        m = self.evaluator(
+            state.variables, self.arrays.test_x, self.arrays.test_y
+        )
+        return {k: float(v) for k, v in m.items()}
+
+    def evaluate_train(self, state: ServerState) -> dict:
+        m = self.evaluator(state.variables, self.arrays.x, self.arrays.y)
+        return {k: float(v) for k, v in m.items()}
+
+    def run(self, metrics_sink=None) -> ServerState:
+        """Round loop (reference ``fedavg_api.train``,
+        ``standalone/fedavg/fedavg_api.py:40-81``)."""
+        state = self.init()
+        for r in range(self.cfg.fed.num_rounds):
+            state, train_m = self.run_round(state)
+            record = {"round": r, **{k: float(v) for k, v in train_m.items()}}
+            if (r + 1) % self.cfg.fed.eval_every == 0 or (
+                r == self.cfg.fed.num_rounds - 1
+            ):
+                test_m = self.evaluate_global(state)
+                record.update(
+                    {"test_acc": test_m["acc"], "test_loss": test_m["loss"]}
+                )
+            if metrics_sink is not None:
+                metrics_sink.log(record)
+        return state

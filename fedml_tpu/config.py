@@ -1,0 +1,148 @@
+"""Typed, immutable experiment configuration.
+
+Replaces the reference's mutable argparse ``args`` namespace that is passed
+whole through every layer and mutated en route (reference:
+``fedml_experiments/distributed/fedavg/main_fedavg.py:46-130``,
+``fedml_experiments/standalone/utils/config.py:4-64``; see SURVEY.md §5.6).
+
+Frozen dataclasses: hashable (usable as jit static args), self-documenting,
+and impossible to mutate mid-run.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Mapping, Sequence
+
+
+@dataclasses.dataclass(frozen=True)
+class DataConfig:
+    """Dataset + partition settings.
+
+    Mirrors the knobs of the reference partition engine
+    (``fedml_api/data_preprocessing/utils/partition.py:16-140``):
+    ``partition_method`` in {"homo", "hetero"} (hetero = Dirichlet LDA),
+    ``partition_alpha`` the LDA concentration, ``dataset_r`` the subsample
+    fraction the fork adds.
+    """
+
+    dataset: str = "synthetic"
+    data_dir: str = "./data"
+    num_clients: int = 10
+    partition_method: str = "homo"  # "homo" | "hetero"
+    partition_alpha: float = 0.5
+    batch_size: int = 32
+    dataset_r: float = 1.0  # fraction of the dataset to keep (fork's `r`)
+    full_batch: bool = False  # reference batch_size=-1 `combine_batches` mode
+    seed: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Model factory settings (reference ``create_model``,
+    ``main_fedavg.py:354-389``)."""
+
+    name: str = "lr"
+    num_classes: int = 10
+    input_shape: tuple[int, ...] = (28, 28, 1)
+    # extra per-model knobs (e.g. hidden sizes); kept as a tuple of pairs so
+    # the dataclass stays hashable.
+    extra: tuple[tuple[str, Any], ...] = ()
+
+    def extra_dict(self) -> dict[str, Any]:
+        return dict(self.extra)
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    """Client-side local training hyperparameters
+    (reference ``MyModelTrainer.train``, ``standalone/fedavg/my_model_trainer_classification.py``)."""
+
+    optimizer: str = "sgd"  # "sgd" | "adam"
+    lr: float = 0.03
+    momentum: float = 0.0
+    weight_decay: float = 0.0
+    epochs: int = 1
+    # FedProx proximal coefficient (0 disables; reference fedprox mu)
+    prox_mu: float = 0.0
+    # gradient clipping by global norm (0 disables)
+    clip_norm: float = 0.0
+
+
+@dataclasses.dataclass(frozen=True)
+class FedConfig:
+    """Server-side / round-level settings (reference ``FedAvgAPI`` args)."""
+
+    algorithm: str = "fedavg"
+    num_rounds: int = 10
+    clients_per_round: int = 10
+    eval_every: int = 5  # reference frequency_of_the_test
+    # server optimizer (FedOpt; "sgd" with lr 1.0 == plain FedAvg)
+    server_optimizer: str = "sgd"
+    server_lr: float = 1.0
+    server_momentum: float = 0.0
+    # robust aggregation (reference fedml_core/robustness/robust_aggregation.py)
+    robust_norm_clip: float = 0.0  # 0 disables norm-diff clipping
+    robust_noise_stddev: float = 0.0  # weak-DP gaussian noise
+    robust_method: str = "mean"  # "mean" | "median" (coordinate-wise)
+    # FedNova normalized averaging
+    gmf: float = 0.0  # global momentum factor
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Device-mesh layout for the scale-out runtime.
+
+    ``client_axis`` shards the sampled cohort; ``data_axis`` shards the
+    per-client batch (the TPU analog of the reference's intra-silo DDP,
+    ``fedavg_cross_silo/process_group_manager.py:6-33``).
+    """
+
+    client_axis_size: int = 1
+    data_axis_size: int = 1
+    client_axis_name: str = "clients"
+    data_axis_name: str = "data"
+
+
+@dataclasses.dataclass(frozen=True)
+class ExperimentConfig:
+    data: DataConfig = dataclasses.field(default_factory=DataConfig)
+    model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
+    train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
+    fed: FedConfig = dataclasses.field(default_factory=FedConfig)
+    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    seed: int = 0
+    run_name: str = "run"
+    out_dir: str = "./runs"
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), default=str, indent=2)
+
+    @staticmethod
+    def from_dict(d: Mapping[str, Any]) -> "ExperimentConfig":
+        def build(cls, sub):
+            if sub is None:
+                return cls()
+            fields = {f.name: f for f in dataclasses.fields(cls)}
+            kw = {}
+            for k, v in sub.items():
+                if k not in fields:
+                    raise KeyError(f"unknown {cls.__name__} field: {k}")
+                if k == "extra" and isinstance(v, Mapping):
+                    v = tuple(sorted(v.items()))
+                if k == "input_shape" and isinstance(v, Sequence):
+                    v = tuple(v)
+                kw[k] = v
+            return cls(**kw)
+
+        return ExperimentConfig(
+            data=build(DataConfig, d.get("data")),
+            model=build(ModelConfig, d.get("model")),
+            train=build(TrainConfig, d.get("train")),
+            fed=build(FedConfig, d.get("fed")),
+            mesh=build(MeshConfig, d.get("mesh")),
+            seed=d.get("seed", 0),
+            run_name=d.get("run_name", "run"),
+            out_dir=d.get("out_dir", "./runs"),
+        )

@@ -1,0 +1,4 @@
+"""Core runtime: pytree math, RNG discipline, messaging, topology, robustness.
+
+TPU-native replacement for the reference's ``fedml_core`` package.
+"""

@@ -1,0 +1,39 @@
+"""RNG discipline and client sampling.
+
+The reference seeds numpy with the round index before sampling clients
+(``fedml_api/distributed/fedavg/FedAVGAggregator.py:90-98``:
+``np.random.seed(round_idx); np.random.choice(..., replace=False)``), which
+makes cohorts reproducible across server restarts. We mirror that with folded
+JAX keys: every round's key is ``fold_in(root, round_idx)``, every client's
+local-training key is ``fold_in(round_key, client_idx)`` — fully deterministic,
+order-independent, and traceable under jit.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def round_key(root: jax.Array, round_idx) -> jax.Array:
+    return jax.random.fold_in(root, round_idx)
+
+
+def client_key(rkey: jax.Array, client_idx) -> jax.Array:
+    return jax.random.fold_in(rkey, client_idx)
+
+
+def sample_clients(
+    key: jax.Array, num_clients: int, clients_per_round: int
+) -> jax.Array:
+    """Sample a cohort without replacement (reference ``client_sampling``,
+    ``FedAVGAggregator.py:90-98``). If the cohort covers the population,
+    returns ``arange`` like the reference does.
+
+    Jit-safe: shapes are static in both branches.
+    """
+    if clients_per_round >= num_clients:
+        return jnp.arange(num_clients, dtype=jnp.int32)
+    return jax.random.choice(
+        key, num_clients, shape=(clients_per_round,), replace=False
+    ).astype(jnp.int32)

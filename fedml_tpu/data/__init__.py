@@ -1,0 +1,16 @@
+"""Data layer: partition engine + federated dataset loaders.
+
+TPU-native replacement for the reference's ``fedml_api/data_preprocessing``
+(21 dataset packages, SURVEY.md §2.5). The central artifact is
+:class:`fedml_tpu.data.federated.FederatedArrays` — the whole federated
+dataset as padded, device-resident arrays addressable by client index, so a
+jitted round can gather any cohort's data without host round-trips.
+"""
+
+from fedml_tpu.data.partition import (
+    partition_indices_test,
+    partition_indices_train,
+    record_class_counts,
+)
+from fedml_tpu.data.federated import FederatedArrays, FederatedData
+from fedml_tpu.data.loaders import load_dataset

@@ -1,0 +1,154 @@
+"""Device-resident federated dataset containers.
+
+The reference materializes one PyTorch ``DataLoader`` per client and returns
+the 8-tuple ``[train_num, test_num, train_global, test_global,
+local_num_dict, train_local_dict, test_local_dict, class_num]``
+(``fedml_api/data_preprocessing/utils/partition.py:140-187``). That shape is
+host-loop-centric; on TPU we want the *whole* federated dataset resident on
+device as flat arrays plus a padded per-client index matrix, so a jitted
+round can gather any cohort's batches with no host round-trip:
+
+- ``x``/``y``: the global training arrays, shape ``[N, ...]``.
+- ``idx``: ``[num_clients, max_n]`` int32 indices into ``x`` (padded by
+  repeating index 0); ``mask`` marks real samples; ``counts`` are the true
+  ``n_k`` used as FedAvg weights.
+
+Memory cost of padding is only the int32 index matrix — the data itself is
+stored once, unpadded. Batches are gathered per step inside ``lax.scan`` so
+no ``[C, max_n, ...]`` tensor is ever materialized.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from fedml_tpu.data import partition as P
+
+
+@struct.dataclass
+class FederatedArrays:
+    """Jit-friendly federated dataset (a pytree; all leaves device arrays)."""
+
+    x: Any  # [N, ...] global train inputs
+    y: Any  # [N, ...] global train targets
+    idx: Any  # [num_clients, max_n] int32 into x/y
+    mask: Any  # [num_clients, max_n] float32 {0,1}
+    counts: Any  # [num_clients] int32 true n_k
+    test_x: Any  # [M, ...] global test inputs
+    test_y: Any  # [M, ...]
+    test_idx: Any  # [num_clients, max_test_n] int32 into test_x
+    test_mask: Any  # [num_clients, max_test_n] float32
+    num_classes: int = struct.field(pytree_node=False)
+
+    @property
+    def num_clients(self) -> int:
+        return self.idx.shape[0]
+
+    @property
+    def max_client_samples(self) -> int:
+        return self.idx.shape[1]
+
+
+def _pad_index_map(
+    idx_map: dict[int, np.ndarray], num_clients: int, pad_multiple: int
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    counts = np.array([len(idx_map[i]) for i in range(num_clients)], np.int32)
+    max_n = int(max(1, counts.max()))
+    if pad_multiple > 1:
+        max_n = ((max_n + pad_multiple - 1) // pad_multiple) * pad_multiple
+    idx = np.zeros((num_clients, max_n), np.int32)
+    mask = np.zeros((num_clients, max_n), np.float32)
+    for i in range(num_clients):
+        n = counts[i]
+        idx[i, :n] = idx_map[i]
+        mask[i, :n] = 1.0
+    return idx, mask, counts
+
+
+@dataclasses.dataclass
+class FederatedData:
+    """Host-side federated dataset: global numpy arrays + per-client index
+    maps. Produced by the loaders, converted to :class:`FederatedArrays` for
+    the compiled simulator. Mirrors the reference 8-tuple contract
+    (``partition.py:186-187``) via :meth:`stats`.
+    """
+
+    x_train: np.ndarray
+    y_train: np.ndarray
+    x_test: np.ndarray
+    y_test: np.ndarray
+    train_idx_map: dict[int, np.ndarray]
+    test_idx_map: dict[int, np.ndarray]
+    num_classes: int
+    task: str = "classification"  # "classification" | "nwp" | "tag_prediction"
+
+    @property
+    def num_clients(self) -> int:
+        return len(self.train_idx_map)
+
+    def stats(self) -> dict[str, Any]:
+        train_counts = {i: len(v) for i, v in self.train_idx_map.items()}
+        return {
+            "train_num": int(sum(train_counts.values())),
+            "test_num": int(sum(len(v) for v in self.test_idx_map.values())),
+            "local_num_dict": train_counts,
+            "class_num": self.num_classes,
+            "class_counts": P.record_class_counts(self.y_train, self.train_idx_map),
+        }
+
+    def to_arrays(
+        self, pad_multiple: int = 1, dtype=jnp.float32
+    ) -> FederatedArrays:
+        idx, mask, counts = _pad_index_map(
+            self.train_idx_map, self.num_clients, pad_multiple
+        )
+        tidx, tmask, _ = _pad_index_map(
+            self.test_idx_map, self.num_clients, pad_multiple
+        )
+        return FederatedArrays(
+            x=jnp.asarray(self.x_train, dtype),
+            y=jnp.asarray(self.y_train),
+            idx=jnp.asarray(idx),
+            mask=jnp.asarray(mask),
+            counts=jnp.asarray(counts),
+            test_x=jnp.asarray(self.x_test, dtype),
+            test_y=jnp.asarray(self.y_test),
+            test_idx=jnp.asarray(tidx),
+            test_mask=jnp.asarray(tmask),
+            num_classes=self.num_classes,
+        )
+
+
+def build_federated_data(
+    x_train: np.ndarray,
+    y_train: np.ndarray,
+    x_test: np.ndarray,
+    y_test: np.ndarray,
+    num_classes: int,
+    num_clients: int,
+    partition_method: str = "homo",
+    alpha: float = 0.5,
+    r: float = 1.0,
+    seed: int = 0,
+    task: str = "classification",
+) -> FederatedData:
+    """Partition global arrays into a :class:`FederatedData` (the loader
+    core shared by image datasets, reference ``load_partition_data``,
+    ``partition.py:140-187``)."""
+    rng = np.random.default_rng(seed)
+    if partition_method == "natural":
+        raise ValueError("natural partitions are built by dataset loaders")
+    label_y = y_train if y_train.ndim == 1 else y_train.argmax(-1)
+    train_map = P.partition_indices_train(
+        label_y, num_classes, partition_method, num_clients, alpha, r, rng
+    )
+    label_yt = y_test if y_test.ndim == 1 else y_test.argmax(-1)
+    test_map = P.partition_indices_test(label_yt, num_classes, num_clients)
+    return FederatedData(
+        x_train, y_train, x_test, y_test, train_map, test_map, num_classes, task
+    )

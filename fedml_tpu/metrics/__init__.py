@@ -1,0 +1,1 @@
+from fedml_tpu.metrics.sink import MetricsSink

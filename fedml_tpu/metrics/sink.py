@@ -1,0 +1,50 @@
+"""Metrics sink: wandb-shaped logging without wandb.
+
+The reference logs everything to wandb (``wandb.log({...})`` throughout, and
+CI reads ``wandb-summary.json``; SURVEY.md §5.5). This sink provides the same
+two artifacts — a step log and a latest-value summary — as JSONL + dict, and
+can forward to wandb when it's importable.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any
+
+
+class MetricsSink:
+    def __init__(self, path: str | None = None, use_wandb: bool = False):
+        self.history: list[dict[str, Any]] = []
+        self.summary: dict[str, Any] = {}
+        self.path = path
+        self._fh = None
+        if path:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            self._fh = open(path, "a")
+        self._wandb = None
+        if use_wandb:
+            try:
+                import wandb  # type: ignore
+
+                self._wandb = wandb
+            except ImportError:
+                pass
+
+    def log(self, record: dict[str, Any]) -> None:
+        record = dict(record, _ts=time.time())
+        self.history.append(record)
+        self.summary.update(
+            {k: v for k, v in record.items() if not k.startswith("_")}
+        )
+        if self._fh:
+            self._fh.write(json.dumps(record, default=float) + "\n")
+            self._fh.flush()
+        if self._wandb is not None and self._wandb.run is not None:
+            self._wandb.log(record)
+
+    def close(self) -> None:
+        if self._fh:
+            self._fh.close()
+            self._fh = None

@@ -1,0 +1,86 @@
+"""Model factory.
+
+Mirrors the reference ``create_model(args, model_name, output_dim)``
+dispatch (``fedml_experiments/distributed/fedavg/main_fedavg.py:354-389``)
+but returns a functional :class:`~fedml_tpu.models.base.FedModel`.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from fedml_tpu.config import ModelConfig
+from fedml_tpu.models.base import FedModel
+from fedml_tpu.models import nlp, vision
+from fedml_tpu.models.vision import (
+    CNNDropOut,
+    CNNOriginalFedAvg,
+    CNNParameterised,
+    LogisticRegression,
+    MobileNet,
+    ResNet18GN,
+    ResNetCIFAR,
+    VGG,
+)
+from fedml_tpu.models.nlp import CharLSTM, NWPLSTM, TagLogisticRegression
+
+
+def create_model(cfg: ModelConfig) -> FedModel:
+    name = cfg.name.lower()
+    nc = cfg.num_classes
+    extra = cfg.extra_dict()
+    if name == "lr":
+        return FedModel(LogisticRegression(nc), cfg.input_shape)
+    if name == "cnn":  # reference "cnn" == CNN_DropOut (main_fedavg.py:360)
+        return FedModel(CNNDropOut(nc), cfg.input_shape, has_dropout=True)
+    if name == "cnn_fedavg":
+        return FedModel(CNNOriginalFedAvg(nc), cfg.input_shape)
+    if name in ("cnn_small", "cnn_medium", "cnn_large"):
+        plans = {
+            "cnn_small": ((16, 32), (64,)),
+            "cnn_medium": ((32, 64), (128,)),
+            "cnn_large": ((64, 128, 256), (256,)),
+        }
+        convs, denses = plans[name]
+        return FedModel(
+            CNNParameterised(nc, convs, denses, extra.get("dropout", 0.0)),
+            cfg.input_shape,
+            has_dropout=extra.get("dropout", 0.0) > 0,
+        )
+    if name.startswith("resnet") and name.endswith("_gn"):
+        if name == "resnet18_gn":
+            return FedModel(ResNet18GN(nc), cfg.input_shape)
+        depth = int(name[len("resnet"):-len("_gn")])
+        return FedModel(
+            ResNetCIFAR(depth, nc, norm="gn"), cfg.input_shape
+        )
+    if name.startswith("resnet"):
+        depth = int(name[len("resnet"):])
+        return FedModel(
+            ResNetCIFAR(depth, nc, norm="bn"),
+            cfg.input_shape,
+            has_batch_stats=True,
+        )
+    if name == "mobilenet":
+        return FedModel(
+            MobileNet(nc, extra.get("width_mult", 1.0)),
+            cfg.input_shape,
+            has_batch_stats=True,
+        )
+    if name == "vgg11":
+        return FedModel(VGG(nc), cfg.input_shape)
+    if name in ("rnn", "char_lstm"):  # shakespeare
+        return FedModel(
+            CharLSTM(vocab_size=extra.get("vocab_size", 90)),
+            cfg.input_shape,
+            input_dtype=jnp.int32,
+        )
+    if name in ("rnn_stackoverflow", "nwp_lstm"):
+        return FedModel(
+            NWPLSTM(vocab_size=extra.get("vocab_size", 10004)),
+            cfg.input_shape,
+            input_dtype=jnp.int32,
+        )
+    if name in ("tag_lr", "stackoverflow_lr"):
+        return FedModel(TagLogisticRegression(nc), cfg.input_shape)
+    raise ValueError(f"unknown model: {cfg.name}")

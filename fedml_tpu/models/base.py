@@ -1,0 +1,59 @@
+"""Model wrapper: a uniform functional interface over flax modules.
+
+The reference's single pluggable training abstraction is the ``ModelTrainer``
+ABC (``fedml_core/trainer/model_trainer.py:7-41``) holding a mutable
+``nn.Module``. The TPU-native equivalent is a *pure-function triple*: the
+model is a flax module, the state is a variables pytree (``params`` +
+optional ``batch_stats``), and train/eval applications are pure so they can
+be vmapped across clients and jitted.
+
+FedAvg aggregates the reference's full ``state_dict`` — including BatchNorm
+running stats (``FedAVGAggregator.py:73-81``); we mirror that by treating the
+whole variables pytree as the unit of aggregation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+Variables = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class FedModel:
+    """Functional handle on one architecture."""
+
+    module: nn.Module
+    input_shape: tuple[int, ...]
+    has_batch_stats: bool = False
+    has_dropout: bool = False
+    # inputs may be int tokens (NLP) rather than floats
+    input_dtype: Any = jnp.float32
+
+    def init(self, rng: jax.Array) -> Variables:
+        dummy = jnp.zeros((1,) + tuple(self.input_shape), self.input_dtype)
+        return self.module.init({"params": rng}, dummy, train=False)
+
+    def apply_train(
+        self, variables: Variables, x: jax.Array, rng: jax.Array
+    ) -> tuple[jax.Array, Variables]:
+        """Forward in train mode; returns (logits, updated variables)."""
+        rngs = {"dropout": rng} if self.has_dropout else None
+        if self.has_batch_stats:
+            logits, mutated = self.module.apply(
+                variables, x, train=True, rngs=rngs, mutable=["batch_stats"]
+            )
+            return logits, {**variables, **mutated}
+        logits = self.module.apply(variables, x, train=True, rngs=rngs)
+        return logits, variables
+
+    def apply_eval(self, variables: Variables, x: jax.Array) -> jax.Array:
+        return self.module.apply(variables, x, train=False)
+
+
+LossFn = Callable[[jax.Array, jax.Array, jax.Array], jax.Array]

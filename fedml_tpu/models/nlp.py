@@ -1,0 +1,61 @@
+"""NLP models: the reference's federated LSTM pair (flax linen).
+
+Reference ``fedml_api/model/nlp/rnn.py``:
+- ``RNN_OriginalFedAvg`` (``:4``): shakespeare char LM — embed(8) -> 2x
+  LSTM(256) -> dense(vocab), per-position logits.
+- ``RNN_StackOverFlow`` (``:39``): next-word prediction — embed(96) ->
+  LSTM(670) -> dense(96) -> dense(vocab).
+
+LSTMs run as ``nn.RNN`` over ``OptimizedLSTMCell`` — an ``lax.scan`` under
+the hood, so the whole sequence unrolls inside one XLA computation (static
+shapes, MXU-friendly batched gates).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class CharLSTM(nn.Module):
+    """Shakespeare char-LM (reference ``RNN_OriginalFedAvg``,
+    ``model/nlp/rnn.py:4``)."""
+
+    vocab_size: int = 90
+    embed_dim: int = 8
+    hidden: int = 256
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        x = nn.Embed(self.vocab_size, self.embed_dim)(tokens)
+        x = nn.RNN(nn.OptimizedLSTMCell(self.hidden))(x)
+        x = nn.RNN(nn.OptimizedLSTMCell(self.hidden))(x)
+        return nn.Dense(self.vocab_size)(x)  # [B, T, vocab]
+
+
+class NWPLSTM(nn.Module):
+    """StackOverflow next-word predictor (reference ``RNN_StackOverFlow``,
+    ``model/nlp/rnn.py:39``)."""
+
+    vocab_size: int = 10004  # 10k words + pad/bos/eos/oov
+    embed_dim: int = 96
+    hidden: int = 670
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        x = nn.Embed(self.vocab_size, self.embed_dim)(tokens)
+        x = nn.RNN(nn.OptimizedLSTMCell(self.hidden))(x)
+        x = nn.Dense(self.embed_dim)(x)
+        return nn.Dense(self.vocab_size)(x)
+
+
+class TagLogisticRegression(nn.Module):
+    """Multi-label bag-of-words tagger (stackoverflow_lr; reference
+    multilabel trainer path ``fedml_core/trainer/model_trainer.py:57-112``)."""
+
+    num_tags: int = 500
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1))
+        return nn.Dense(self.num_tags)(x)  # sigmoid applied in the loss

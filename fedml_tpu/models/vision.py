@@ -1,0 +1,219 @@
+"""Vision model zoo (flax linen).
+
+TPU-native re-designs of the reference zoo (``fedml_api/model/cv``,
+SURVEY.md §2.4): logistic regression, the FedAvg-paper CNNs, CIFAR ResNets
+(BatchNorm), ResNet-18 with GroupNorm, MobileNet(V1), VGG, and the fork's
+parameterised small/medium/large CNNs. All use NHWC layout and default to
+``float32`` params with matmuls free to run bfloat16 on the MXU via jax
+default precision.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class LogisticRegression(nn.Module):
+    """Flatten -> dense (reference ``fedml_api/model/linear/lr.py:4``)."""
+
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1))
+        return nn.Dense(self.num_classes)(x)
+
+
+class CNNOriginalFedAvg(nn.Module):
+    """2x(conv5x5 + maxpool) + dense-512 CNN from the FedAvg paper
+    (reference ``fedml_api/model/cv/cnn.py:5``)."""
+
+    num_classes: int = 62
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(32, (5, 5), padding="SAME")(x)
+        x = nn.max_pool(nn.relu(x), (2, 2), (2, 2))
+        x = nn.Conv(64, (5, 5), padding="SAME")(x)
+        x = nn.max_pool(nn.relu(x), (2, 2), (2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(512)(x))
+        return nn.Dense(self.num_classes)(x)
+
+
+class CNNDropOut(nn.Module):
+    """Conv net with dropout (reference ``fedml_api/model/cv/cnn.py:74``)."""
+
+    num_classes: int = 62
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.relu(nn.Conv(32, (3, 3), padding="VALID")(x))
+        x = nn.relu(nn.Conv(64, (3, 3), padding="VALID")(x))
+        x = nn.max_pool(x, (2, 2), (2, 2))
+        x = nn.Dropout(0.25, deterministic=not train)(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(128)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+class CNNParameterised(nn.Module):
+    """Configurable conv stack — the fork's heterogeneous-client models
+    (reference ``fedml_api/model/cv/cnn_custom.py:8`` with
+    CNNSmall/Medium/Large builders)."""
+
+    num_classes: int = 10
+    conv_channels: Sequence[int] = (32, 64)
+    dense_sizes: Sequence[int] = (128,)
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        for ch in self.conv_channels:
+            x = nn.relu(nn.Conv(ch, (3, 3), padding="SAME")(x))
+            x = nn.max_pool(x, (2, 2), (2, 2))
+        x = x.reshape((x.shape[0], -1))
+        for d in self.dense_sizes:
+            x = nn.relu(nn.Dense(d)(x))
+            if self.dropout > 0:
+                x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+def _norm(kind: str, train: bool):
+    if kind == "bn":
+        return nn.BatchNorm(use_running_average=not train, momentum=0.9)
+    if kind == "gn":
+        return nn.GroupNorm(num_groups=2)
+    raise ValueError(kind)
+
+
+class BasicBlock(nn.Module):
+    """CIFAR ResNet basic block (reference
+    ``fedml_api/model/cv/resnet.py:30``; GN variant ``resnet_gn.py``)."""
+
+    channels: int
+    stride: int = 1
+    norm: str = "bn"
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        residual = x
+        y = nn.Conv(self.channels, (3, 3), (self.stride, self.stride),
+                    padding="SAME", use_bias=False)(x)
+        y = _norm(self.norm, train)(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.channels, (3, 3), padding="SAME", use_bias=False)(y)
+        y = _norm(self.norm, train)(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.channels, (1, 1),
+                               (self.stride, self.stride),
+                               use_bias=False)(x)
+            residual = _norm(self.norm, train)(residual)
+        return nn.relu(y + residual)
+
+
+class ResNetCIFAR(nn.Module):
+    """3-stage CIFAR ResNet: depth = 6n+2 (resnet56 => n=9; reference
+    ``fedml_api/model/cv/resnet.py:113``)."""
+
+    depth: int = 56
+    num_classes: int = 10
+    norm: str = "bn"
+    width: int = 16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        n = (self.depth - 2) // 6
+        x = nn.Conv(self.width, (3, 3), padding="SAME", use_bias=False)(x)
+        x = _norm(self.norm, train)(x)
+        x = nn.relu(x)
+        for stage, ch in enumerate(
+            (self.width, 2 * self.width, 4 * self.width)
+        ):
+            for blk in range(n):
+                stride = 2 if (stage > 0 and blk == 0) else 1
+                x = BasicBlock(ch, stride, self.norm)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+class ResNet18GN(nn.Module):
+    """ImageNet-style ResNet-18 with GroupNorm, used by fed_cifar100
+    (reference ``fedml_api/model/cv/resnet_gn.py:108``)."""
+
+    num_classes: int = 100
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(64, (3, 3), padding="SAME", use_bias=False)(x)
+        x = nn.GroupNorm(num_groups=2)(x)
+        x = nn.relu(x)
+        for stage, ch in enumerate((64, 128, 256, 512)):
+            for blk in range(2):
+                stride = 2 if (stage > 0 and blk == 0) else 1
+                x = BasicBlock(ch, stride, norm="gn")(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+class DepthwiseSeparable(nn.Module):
+    channels: int
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        in_ch = x.shape[-1]
+        x = nn.Conv(in_ch, (3, 3), (self.stride, self.stride),
+                    padding="SAME", feature_group_count=in_ch,
+                    use_bias=False)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
+        x = nn.relu(x)
+        x = nn.Conv(self.channels, (1, 1), use_bias=False)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
+        return nn.relu(x)
+
+
+class MobileNet(nn.Module):
+    """MobileNetV1 (reference ``fedml_api/model/cv/mobilenet.py:60``)."""
+
+    num_classes: int = 10
+    width_mult: float = 1.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        def c(ch):
+            return max(8, int(ch * self.width_mult))
+
+        x = nn.Conv(c(32), (3, 3), (1, 1), padding="SAME", use_bias=False)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
+        x = nn.relu(x)
+        plan = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+                *[(512, 1)] * 5, (1024, 2), (1024, 1)]
+        for ch, s in plan:
+            x = DepthwiseSeparable(c(ch), s)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+class VGG(nn.Module):
+    """VGG-11/16 style stack (reference ``fedml_api/model/cv/vgg.py:13``)."""
+
+    num_classes: int = 10
+    plan: Sequence[Any] = (64, "M", 128, "M", 256, 256, "M", 512, 512, "M",
+                           512, 512, "M")
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        for p in self.plan:
+            if p == "M":
+                x = nn.max_pool(x, (2, 2), (2, 2))
+            else:
+                x = nn.relu(nn.Conv(int(p), (3, 3), padding="SAME")(x))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(512)(x))
+        return nn.Dense(self.num_classes)(x)

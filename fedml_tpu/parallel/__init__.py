@@ -1,0 +1,19 @@
+"""Parallelism layer: device meshes, client-sharded rounds, hierarchical
+aggregation.
+
+The reference's scale-out axes (SURVEY.md §2.7) map onto a
+``jax.sharding.Mesh``:
+
+- client/population parallelism (one MPI rank per client) -> shard the
+  sampled cohort over the ``clients`` mesh axis;
+- intra-silo data parallelism (DDP over NCCL/Gloo,
+  ``fedavg_cross_silo/process_group_manager.py``) -> shard the per-client
+  batch over the ``data`` axis;
+- hierarchical aggregation (``standalone/hierarchical_fl``) -> two-level
+  ``psum`` (intra-group then inter-group).
+
+All collectives are XLA collectives riding ICI; no NCCL/MPI anywhere.
+"""
+
+from fedml_tpu.parallel.mesh import make_mesh
+from fedml_tpu.parallel.client_parallel import ShardedFedAvg

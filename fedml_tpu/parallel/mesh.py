@@ -1,0 +1,32 @@
+"""Mesh construction helpers."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+
+def make_mesh(
+    client_axis: int | None = None,
+    data_axis: int = 1,
+    axis_names: tuple[str, str] = ("clients", "data"),
+    devices=None,
+) -> Mesh:
+    """Build a 2-D (clients, data) mesh.
+
+    ``client_axis=None`` uses all remaining devices. The ``clients`` axis is
+    the FL population axis (the reference's one-process-per-client MPI
+    layout, ``distributed/fedavg/FedAvgAPI.py:36-66``); the ``data`` axis is
+    the intra-client DDP analog.
+    """
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if client_axis is None:
+        assert n % data_axis == 0, (n, data_axis)
+        client_axis = n // data_axis
+    assert client_axis * data_axis <= n, (client_axis, data_axis, n)
+    grid = np.array(devices[: client_axis * data_axis]).reshape(
+        client_axis, data_axis
+    )
+    return Mesh(grid, axis_names)

@@ -1,0 +1,23 @@
+"""Test harness: force an 8-device virtual CPU mesh.
+
+Multi-chip hardware isn't available in CI; per the project conventions we
+validate all sharding logic on a virtual CPU mesh
+(``xla_force_host_platform_device_count``). The environment's sitecustomize
+registers the TPU backend and pins ``jax_platforms``, so we must override
+via ``jax.config.update`` (env vars alone are not enough).
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)
+
+assert len(jax.devices()) == 8, jax.devices()

@@ -1,0 +1,53 @@
+"""Partition engine tests (semantics of reference
+``fedml_api/data_preprocessing/utils/partition.py``)."""
+
+import numpy as np
+
+from fedml_tpu.data import partition as P
+
+
+def test_homo_partition_covers_all():
+    y = np.random.default_rng(0).integers(0, 10, 1000)
+    m = P.partition_indices_train(y, 10, "homo", 7, rng=np.random.default_rng(1))
+    all_idx = np.concatenate([m[i] for i in range(7)])
+    assert len(all_idx) == 1000
+    assert len(np.unique(all_idx)) == 1000
+
+
+def test_hetero_partition_min_size_and_coverage():
+    y = np.random.default_rng(0).integers(0, 10, 2000)
+    m = P.partition_indices_train(
+        y, 10, "hetero", 8, alpha=0.5, rng=np.random.default_rng(2)
+    )
+    sizes = [len(m[i]) for i in range(8)]
+    assert min(sizes) >= P.MIN_PARTITION_SIZE
+    all_idx = np.concatenate([m[i] for i in range(8)])
+    assert len(np.unique(all_idx)) == len(all_idx) == 2000
+
+
+def test_hetero_is_noniid():
+    """Small alpha should produce skewed label distributions."""
+    y = np.random.default_rng(0).integers(0, 10, 5000)
+    m = P.partition_indices_train(
+        y, 10, "hetero", 10, alpha=0.1, rng=np.random.default_rng(3)
+    )
+    counts = P.record_class_counts(y, m)
+    # at least one client should be missing at least one class entirely
+    assert any(len(c) < 10 for c in counts.values())
+
+
+def test_subsample_r():
+    y = np.random.default_rng(0).integers(0, 10, 1000)
+    m = P.partition_indices_train(
+        y, 10, "homo", 4, r=0.5, rng=np.random.default_rng(4)
+    )
+    assert sum(len(m[i]) for i in range(4)) == 500
+
+
+def test_test_partition_per_label_equal():
+    y = np.repeat(np.arange(10), 100)  # 100 of each label
+    m = P.partition_indices_test(y, 10, 5)
+    for u in range(5):
+        labels, counts = np.unique(y[m[u]], return_counts=True)
+        assert list(labels) == list(range(10))
+        assert all(c == 20 for c in counts)
