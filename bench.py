@@ -133,7 +133,12 @@ def main():
 
     vs = float("nan")
     if not args.skip_torch_baseline:
-        steps_per_client = sim.arrays.max_client_samples // sim.batch_size
+        # the reference serial loop runs ceil(n_k/B) real batches per
+        # sampled client — use the mean over clients, NOT the padded max
+        counts = np.asarray(sim.arrays.counts)
+        steps_per_client = float(
+            np.mean(np.ceil(counts / sim.batch_size))
+        )
         base_round_s = torch_baseline_round_seconds(steps_per_client, 10)
         vs = rps * base_round_s  # ratio of round rates
 

@@ -36,20 +36,39 @@ Pytree = Any
 
 @dataclasses.dataclass(frozen=True)
 class Task:
-    """Weighted loss + sufficient-statistics metrics for one task type."""
+    """Sufficient-statistics metrics for one task type.
+
+    ``metric_sums(logits, y, w)`` returns additive SUMS:
+    ``loss_sum`` (weighted loss numerator), ``w_sum`` (loss denominator),
+    ``correct`` / ``count`` (accuracy numerator / denominator — for the tag
+    task these are micro-precision TP / predicted-positives). Reduce sums
+    across batches/clients/shards first, then call :func:`finalize_sums`.
+    """
 
     name: str
-    # (logits, y, weights[B]) -> scalar mean loss
-    loss: Callable[[jax.Array, jax.Array, jax.Array], jax.Array]
-    # (logits, y, weights[B]) -> dict of SUMS {loss_sum, correct, count}
     metric_sums: Callable[[jax.Array, jax.Array, jax.Array], dict]
 
 
-def _classification_task() -> Task:
-    def loss(logits, y, w):
-        ce = optax.softmax_cross_entropy_with_integer_labels(logits, y)
-        return jnp.sum(ce * w) / jnp.maximum(jnp.sum(w), 1.0)
+def zero_sums() -> dict:
+    return {
+        "loss_sum": jnp.asarray(0.0),
+        "correct": jnp.asarray(0.0),
+        "count": jnp.asarray(0.0),
+        "w_sum": jnp.asarray(0.0),
+    }
 
+
+def finalize_sums(sums: dict) -> dict:
+    """Turn reduced metric sums into {loss, acc}. Clamps are applied ONCE
+    here, after the final reduction, so per-batch zero-prediction batches
+    don't distort micro-precision."""
+    return {
+        "loss": sums["loss_sum"] / jnp.maximum(sums["w_sum"], 1.0),
+        "acc": sums["correct"] / jnp.maximum(sums["count"], 1.0),
+    }
+
+
+def _classification_task() -> Task:
     def sums(logits, y, w):
         ce = optax.softmax_cross_entropy_with_integer_labels(logits, y)
         correct = (jnp.argmax(logits, -1) == y).astype(jnp.float32)
@@ -60,24 +79,15 @@ def _classification_task() -> Task:
             "w_sum": jnp.sum(w),
         }
 
-    return Task("classification", loss, sums)
+    return Task("classification", sums)
 
 
 def _nwp_task() -> Task:
     """Next-word/char prediction: logits [B,T,V], y [B,T]; token-level
     accuracy (reference ``my_model_trainer_nwp.py``)."""
 
-    def per_token(logits, y):
-        return optax.softmax_cross_entropy_with_integer_labels(logits, y)
-
-    def loss(logits, y, w):
-        ce = per_token(logits, y)  # [B, T]
-        return jnp.sum(ce * w[:, None]) / jnp.maximum(
-            jnp.sum(w) * y.shape[1], 1.0
-        )
-
     def sums(logits, y, w):
-        ce = per_token(logits, y)
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, y)
         correct = (jnp.argmax(logits, -1) == y).astype(jnp.float32)
         tokens = jnp.sum(w) * y.shape[1]
         return {
@@ -87,17 +97,13 @@ def _nwp_task() -> Task:
             "w_sum": tokens,
         }
 
-    return Task("nwp", loss, sums)
+    return Task("nwp", sums)
 
 
 def _tag_task() -> Task:
     """Multi-label tag prediction with sigmoid BCE; accuracy = micro
     precision at threshold 0.5 (reference multilabel path,
     ``fedml_core/trainer/model_trainer.py:57-112``)."""
-
-    def loss(logits, y, w):
-        bce = optax.sigmoid_binary_cross_entropy(logits, y).mean(-1)
-        return jnp.sum(bce * w) / jnp.maximum(jnp.sum(w), 1.0)
 
     def sums(logits, y, w):
         bce = optax.sigmoid_binary_cross_entropy(logits, y).mean(-1)
@@ -106,12 +112,12 @@ def _tag_task() -> Task:
         predicted = jnp.sum(pred * w[:, None])
         return {
             "loss_sum": jnp.sum(bce * w),
-            "correct": tp,  # numerator of micro-precision
-            "count": jnp.maximum(predicted, 1.0),
+            "correct": tp,  # micro-precision numerator
+            "count": predicted,  # micro-precision denominator (raw sum)
             "w_sum": jnp.sum(w),
         }
 
-    return Task("tag_prediction", loss, sums)
+    return Task("tag_prediction", sums)
 
 
 def make_task(name: str) -> Task:
@@ -208,7 +214,15 @@ def build_local_update(
 
         def epoch_body(carry, ekey):
             variables, opt_state, msums = carry
+            # Shuffle, then stable-sort so real samples occupy the first
+            # ceil(n_k/B) batches (shuffled among themselves) and trailing
+            # batches are fully padding. This makes a small client take
+            # exactly its serial-equivalent number of optimizer steps
+            # instead of scattering 1-2 real samples into many full-lr
+            # steps — and keeps FedNova's tau = ceil(n_k/B)*epochs exact.
             perm = jax.random.permutation(ekey, max_n)
+            order = jnp.argsort(1.0 - mask_row[perm], stable=True)
+            perm = perm[order]
 
             def step_body(carry2, step):
                 variables, opt_state, msums = carry2
@@ -248,8 +262,12 @@ def build_local_update(
                     grads, opt_state, params
                 )
                 new_params = optax.apply_updates(params, updates)
-                # gate: a fully-padded batch must be a strict no-op
-                valid = jnp.sum(w_b) > 0
+                # gate: a fully-padded batch must be a strict no-op. Uses
+                # the data-axis-psum'd weight total (sums were psum'd
+                # above) so every data shard takes the SAME branch — a
+                # shard whose slice happens to be all padding must still
+                # apply the collective update or shards silently diverge.
+                valid = sums["w_sum"] > 0
                 sel = lambda n, o: jax.tree.map(
                     lambda a, b: jnp.where(valid, a, b), n, o
                 )
@@ -267,12 +285,7 @@ def build_local_update(
             return (variables, opt_state, msums), None
 
         opt_state = opt.init(global_vars["params"])
-        msums0 = {
-            "loss_sum": jnp.asarray(0.0),
-            "correct": jnp.asarray(0.0),
-            "count": jnp.asarray(0.0),
-            "w_sum": jnp.asarray(0.0),
-        }
+        msums0 = zero_sums()
         ekeys = jax.vmap(lambda e: jax.random.fold_in(rng, e))(
             jnp.arange(cfg.epochs)
         )
@@ -312,17 +325,7 @@ def build_evaluator(model: FedModel, task: Task, eval_batch: int = 256):
             s = task.metric_sums(logits, sl(yp), sl(w))
             return {k: sums[k] + s[k] for k in sums}, None
 
-        sums0 = {
-            "loss_sum": jnp.asarray(0.0),
-            "correct": jnp.asarray(0.0),
-            "count": jnp.asarray(0.0),
-            "w_sum": jnp.asarray(0.0),
-        }
-        sums, _ = jax.lax.scan(body, sums0, jnp.arange(nb))
-        return {
-            "loss": sums["loss_sum"] / jnp.maximum(sums["w_sum"], 1.0),
-            "acc": sums["correct"] / jnp.maximum(sums["count"], 1.0),
-            "count": sums["count"],
-        }
+        sums, _ = jax.lax.scan(body, zero_sums(), jnp.arange(nb))
+        return {**finalize_sums(sums), "count": sums["count"]}
 
     return jax.jit(evaluate)

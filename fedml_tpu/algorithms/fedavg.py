@@ -14,24 +14,29 @@ configuration of the same compiled round:
 
 One ``jax.jit`` round; all state device-resident; the python loop only
 sequences rounds and reads metrics.
+
+The server aggregation is written once, parameterized by a :class:`Reducer`
+— plain in-device reduction for the single-chip simulator, ``psum`` /
+``all_gather`` over the ``clients`` mesh axis for the sharded runtime
+(:mod:`fedml_tpu.parallel.client_parallel`) — so the two paths cannot drift.
 """
 
 from __future__ import annotations
 
-import math
-from typing import Any, NamedTuple
+from typing import Any, Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp
 import optax
 
-from fedml_tpu.config import ExperimentConfig
+from fedml_tpu.config import ExperimentConfig, FedConfig, TrainConfig
 from fedml_tpu.core import random as R
 from fedml_tpu.core import robust, tree as T
 from fedml_tpu.data.federated import FederatedArrays, FederatedData
 from fedml_tpu.algorithms.base import (
     build_evaluator,
     build_local_update,
+    finalize_sums,
     make_task,
 )
 from fedml_tpu.models.base import FedModel
@@ -42,8 +47,42 @@ Pytree = Any
 class ServerState(NamedTuple):
     variables: Pytree  # full model variables (params [+ batch_stats])
     opt_state: Any  # server optimizer state
-    momentum: Pytree  # FedNova global momentum buffer
+    momentum: Pytree  # global momentum buffer (FedNova gmf)
     round: jax.Array  # int32
+
+
+class Reducer(NamedTuple):
+    """How to reduce per-client quantities over the (possibly sharded)
+    cohort. ``wmean(stacked, w)``: weighted mean over ALL clients;
+    ``sum_scalar``: global scalar sum; ``gather``: full stacked tree (for
+    coordinate-wise defenses)."""
+
+    wmean: Callable[[Pytree, jax.Array], Pytree]
+    sum_scalar: Callable[[jax.Array], jax.Array]
+    gather: Callable[[Pytree], Pytree]
+
+
+def local_reducer() -> Reducer:
+    return Reducer(
+        wmean=T.tree_weighted_mean,
+        sum_scalar=lambda s: s,
+        gather=lambda t: t,
+    )
+
+
+def psum_reducer(axis: str) -> Reducer:
+    def wmean(stacked, w):
+        n_total = jax.lax.psum(jnp.sum(w), axis)
+        local = T.tree_weighted_sum(stacked, w)
+        return jax.tree.map(lambda v: jax.lax.psum(v, axis) / n_total, local)
+
+    return Reducer(
+        wmean=wmean,
+        sum_scalar=lambda s: jax.lax.psum(s, axis),
+        gather=lambda t: jax.tree.map(
+            lambda v: jax.lax.all_gather(v, axis, tiled=True), t
+        ),
+    )
 
 
 def make_server_optimizer(name: str, lr: float, momentum: float):
@@ -58,6 +97,87 @@ def make_server_optimizer(name: str, lr: float, momentum: float):
     if name == "yogi":
         return optax.yogi(lr)
     raise ValueError(f"unknown server optimizer: {name}")
+
+
+def server_update(
+    fed: FedConfig,
+    train: TrainConfig,
+    steps_per_epoch: int,
+    batch_size: int,
+    state: ServerState,
+    stacked_vars: Pytree,
+    n_k: jax.Array,
+    rkey: jax.Array,
+    red: Reducer,
+) -> ServerState:
+    """One server step from stacked client results. Shared between the
+    single-device and mesh-sharded rounds (reference equivalents:
+    ``FedAVGAggregator.aggregate``, ``FedOptAggregator``,
+    ``fednova.py`` tau-normalized averaging, ``RobustAggregator``)."""
+    global_params = state.variables["params"]
+    deltas = jax.tree.map(
+        lambda s, g: s - g[None], stacked_vars["params"], global_params
+    )
+
+    if fed.robust_norm_clip > 0:
+        deltas = robust.clip_deltas_by_norm(deltas, fed.robust_norm_clip)
+
+    if fed.algorithm == "fednova":
+        # tau_k = true local steps (real-first batch ordering makes this
+        # exact); d_k = delta_k / tau_k; delta = tau_eff * sum p_k d_k
+        tau = (
+            jnp.ceil(n_k / batch_size).clip(1, steps_per_epoch)
+            * train.epochs
+        )
+        n_total = red.sum_scalar(jnp.sum(n_k))
+        tau_eff = red.sum_scalar(jnp.sum(n_k * tau)) / n_total
+        d = jax.tree.map(
+            lambda v: v / tau.reshape((-1,) + (1,) * (v.ndim - 1)), deltas
+        )
+        agg_delta = T.tree_scale(red.wmean(d, n_k), tau_eff)
+    elif fed.robust_method == "median":
+        agg_delta = robust.coordinate_median(red.gather(deltas))
+    elif fed.robust_method == "trimmed_mean":
+        agg_delta = robust.trimmed_mean(red.gather(deltas))
+    else:
+        agg_delta = red.wmean(deltas, n_k)
+
+    if fed.robust_noise_stddev > 0:
+        agg_delta = robust.add_gaussian_noise(
+            agg_delta, fed.robust_noise_stddev, jax.random.fold_in(rkey, 1)
+        )
+
+    # global momentum buffer (FedNova gmf; reference fednova.py gmf option)
+    if fed.gmf > 0:
+        new_momentum = T.tree_add(
+            T.tree_scale(state.momentum, fed.gmf), agg_delta
+        )
+        agg_delta = new_momentum
+    else:
+        new_momentum = state.momentum
+
+    opt = make_server_optimizer(
+        fed.server_optimizer, fed.server_lr, fed.server_momentum
+    )
+    pseudo_grad = T.tree_scale(agg_delta, -1.0)
+    updates, new_opt_state = opt.update(
+        pseudo_grad, state.opt_state, global_params
+    )
+    new_params = optax.apply_updates(global_params, updates)
+
+    # non-param collections (batch_stats): plain weighted mean, like the
+    # reference's full-state_dict averaging (FedAVGAggregator.py:73-81)
+    other = {
+        k: red.wmean(v, n_k)
+        for k, v in stacked_vars.items()
+        if k != "params"
+    }
+    return ServerState(
+        variables={**other, "params": new_params},
+        opt_state=new_opt_state,
+        momentum=new_momentum,
+        round=state.round + 1,
+    )
 
 
 class FedAvgSim:
@@ -79,6 +199,7 @@ class FedAvgSim:
         self.batch_size = max_n if cfg.data.full_batch else min(
             cfg.data.batch_size, max_n
         )
+        self.steps_per_epoch = max_n // self.batch_size
         self.local_update = build_local_update(
             model, self.task, cfg.train, self.batch_size, max_n
         )
@@ -120,83 +241,24 @@ class FedAvgSim:
             self.local_update, in_axes=(None, 0, 0, None, None, 0)
         )(state.variables, idx_rows, mask_rows, arrays.x, arrays.y, ckeys)
 
-        new_state = self._server_update(state, stacked_vars, n_k, rkey)
+        new_state = server_update(
+            cfg,
+            self.cfg.train,
+            self.steps_per_epoch,
+            self.batch_size,
+            state,
+            stacked_vars,
+            n_k,
+            rkey,
+            local_reducer(),
+        )
+        reduced = jax.tree.map(jnp.sum, msums)
+        fin = finalize_sums(reduced)
         train_metrics = {
-            "train_loss": msums["loss_sum"].sum()
-            / jnp.maximum(msums["count"].sum(), 1.0),
-            "train_acc": msums["correct"].sum()
-            / jnp.maximum(msums["count"].sum(), 1.0),
+            "train_loss": fin["loss"],
+            "train_acc": fin["acc"],
         }
         return new_state, train_metrics
-
-    def _server_update(
-        self,
-        state: ServerState,
-        stacked_vars: Pytree,
-        n_k: jax.Array,
-        rkey: jax.Array,
-    ) -> ServerState:
-        cfg = self.cfg.fed
-        global_params = state.variables["params"]
-        stacked_params = {"params": stacked_vars["params"]}["params"]
-        # client deltas (w_k - w_global)
-        deltas = jax.tree.map(
-            lambda s, g: s - g[None], stacked_params, global_params
-        )
-
-        if cfg.robust_norm_clip > 0:
-            deltas = robust.clip_deltas_by_norm(deltas, cfg.robust_norm_clip)
-
-        if self.cfg.fed.algorithm == "fednova":
-            # tau_k = true local steps; normalize each delta, rescale by
-            # tau_eff (reference fednova.py aggregate, tau-normalization)
-            steps_pe = self.arrays.max_client_samples // self.batch_size
-            tau = (
-                jnp.ceil(n_k / self.batch_size).clip(1, steps_pe)
-                * self.cfg.train.epochs
-            )
-            p_k = n_k / jnp.maximum(n_k.sum(), 1.0)
-            tau_eff = jnp.sum(p_k * tau)
-            d = jax.tree.map(
-                lambda x: x / tau.reshape((-1,) + (1,) * (x.ndim - 1)), deltas
-            )
-            agg_delta = T.tree_scale(T.tree_weighted_mean(d, n_k), tau_eff)
-        elif cfg.robust_method == "median":
-            agg_delta = robust.coordinate_median(deltas)
-        elif cfg.robust_method == "trimmed_mean":
-            agg_delta = robust.trimmed_mean(deltas)
-        else:
-            agg_delta = T.tree_weighted_mean(deltas, n_k)
-
-        if cfg.robust_noise_stddev > 0:
-            agg_delta = robust.add_gaussian_noise(
-                agg_delta, cfg.robust_noise_stddev, jax.random.fold_in(rkey, 1)
-            )
-
-        # server optimizer on the pseudo-gradient -agg_delta
-        opt = make_server_optimizer(
-            cfg.server_optimizer, cfg.server_lr, cfg.server_momentum
-        )
-        pseudo_grad = T.tree_scale(agg_delta, -1.0)
-        updates, new_opt_state = opt.update(
-            pseudo_grad, state.opt_state, global_params
-        )
-        new_params = optax.apply_updates(global_params, updates)
-
-        # non-param collections (batch_stats): plain weighted mean, like the
-        # reference's full-state_dict averaging (FedAVGAggregator.py:73-81)
-        other = {
-            k: T.tree_weighted_mean(v, n_k)
-            for k, v in stacked_vars.items()
-            if k != "params"
-        }
-        new_variables = {**other, "params": new_params}
-        return ServerState(
-            variables=new_variables,
-            opt_state=new_opt_state,
-            momentum=state.momentum,
-            round=state.round + 1,
-        )
 
     # -- public API --------------------------------------------------------
     def run_round(self, state: ServerState):

@@ -12,24 +12,29 @@ layers at once:
   NCCL) -> the ``data`` mesh axis: per-batch gradient ``psum`` inside the
   compiled local update.
 
-Weighted FedAvg identity used throughout:
-``avg = psum(sum_local n_k * w_k) / psum(sum_local n_k)``.
+The server step itself is the SAME function as the single-device simulator
+(:func:`fedml_tpu.algorithms.fedavg.server_update`), instantiated with a
+``psum``/``all_gather`` reducer — so the sharded path cannot drift from the
+reference-equivalent math (and ``tests/test_sharded.py`` proves equality).
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-import optax
-from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
 
 from fedml_tpu.config import ExperimentConfig
 from fedml_tpu.core import random as R
-from fedml_tpu.core import robust, tree as T
 from fedml_tpu.data.federated import FederatedData
-from fedml_tpu.algorithms.base import build_local_update, make_task, build_evaluator
-from fedml_tpu.algorithms.fedavg import FedAvgSim, ServerState, make_server_optimizer
+from fedml_tpu.algorithms.base import build_local_update, finalize_sums
+from fedml_tpu.algorithms.fedavg import (
+    FedAvgSim,
+    ServerState,
+    psum_reducer,
+    server_update,
+)
 from fedml_tpu.models.base import FedModel
 
 
@@ -48,8 +53,10 @@ class ShardedFedAvg(FedAvgSim):
         self.data_axis = cfg.mesh.data_axis_name
         self.n_client_shards = mesh.shape[self.client_axis]
         self.n_data_shards = mesh.shape[self.data_axis]
-        assert cfg.fed.clients_per_round % self.n_client_shards == 0, (
-            "clients_per_round must divide evenly over the clients mesh axis"
+        cohort = min(cfg.fed.clients_per_round, cfg.data.num_clients)
+        assert cohort % self.n_client_shards == 0, (
+            f"effective cohort size {cohort} must divide evenly over the "
+            f"{self.n_client_shards}-way clients mesh axis"
         )
 
         # FedAvgSim.__init__ builds the single-device local_update; rebuild
@@ -82,119 +89,36 @@ class ShardedFedAvg(FedAvgSim):
 
         cspec = P(self.client_axis)  # shard cohort; replicate over data axis
         rep = P()
+        red = psum_reducer(self.client_axis)
 
-        def shard_fn(variables, opt_state, idx_rows, mask_rows, ckeys, x, y):
+        def shard_fn(state, idx_rows, mask_rows, ckeys, x, y):
             stacked_vars, n_k, msums = jax.vmap(
                 self.local_update, in_axes=(None, 0, 0, None, None, 0)
-            )(variables, idx_rows, mask_rows, x, y, ckeys)
+            )(state.variables, idx_rows, mask_rows, x, y, ckeys)
 
-            global_params = variables["params"]
-            deltas = jax.tree.map(
-                lambda s, g: s - g[None], stacked_vars["params"], global_params
+            new_state = server_update(
+                cfg,
+                self.cfg.train,
+                self.steps_per_epoch,
+                self.batch_size,
+                state,
+                stacked_vars,
+                n_k,
+                rkey,
+                red,
             )
-            if cfg.robust_norm_clip > 0:
-                deltas = robust.clip_deltas_by_norm(
-                    deltas, cfg.robust_norm_clip
-                )
-
-            n_total = jax.lax.psum(jnp.sum(n_k), self.client_axis)
-
-            if self.cfg.fed.algorithm == "fednova":
-                steps_pe = self.arrays.max_client_samples // self.batch_size
-                tau = (
-                    jnp.ceil(n_k / self.batch_size).clip(1, steps_pe)
-                    * self.cfg.train.epochs
-                )
-                tau_eff = (
-                    jax.lax.psum(jnp.sum(n_k * tau), self.client_axis)
-                    / n_total
-                )
-                d = jax.tree.map(
-                    lambda v: v / tau.reshape((-1,) + (1,) * (v.ndim - 1)),
-                    deltas,
-                )
-                local_sum = T.tree_weighted_sum(d, n_k)
-                agg_delta = jax.tree.map(
-                    lambda v: tau_eff
-                    * jax.lax.psum(v, self.client_axis)
-                    / n_total,
-                    local_sum,
-                )
-            elif cfg.robust_method in ("median", "trimmed_mean"):
-                full = jax.tree.map(
-                    lambda v: jax.lax.all_gather(
-                        v, self.client_axis, tiled=True
-                    ),
-                    deltas,
-                )
-                agg_delta = (
-                    robust.coordinate_median(full)
-                    if cfg.robust_method == "median"
-                    else robust.trimmed_mean(full)
-                )
-            else:
-                local_sum = T.tree_weighted_sum(deltas, n_k)
-                agg_delta = jax.tree.map(
-                    lambda v: jax.lax.psum(v, self.client_axis) / n_total,
-                    local_sum,
-                )
-
-            if cfg.robust_noise_stddev > 0:
-                agg_delta = robust.add_gaussian_noise(
-                    agg_delta,
-                    cfg.robust_noise_stddev,
-                    jax.random.fold_in(rkey, 1),
-                )
-
-            opt = make_server_optimizer(
-                cfg.server_optimizer, cfg.server_lr, cfg.server_momentum
-            )
-            pseudo_grad = T.tree_scale(agg_delta, -1.0)
-            updates, new_opt_state = opt.update(
-                pseudo_grad, opt_state, global_params
-            )
-            new_params = optax.apply_updates(global_params, updates)
-
-            other = {
-                k: jax.tree.map(
-                    lambda v: jax.lax.psum(v, self.client_axis) / n_total,
-                    T.tree_weighted_sum(v, n_k),
-                )
-                for k, v in stacked_vars.items()
-                if k != "params"
-            }
-            new_variables = {**other, "params": new_params}
-
-            msums = jax.tree.map(
+            reduced = jax.tree.map(
                 lambda v: jax.lax.psum(jnp.sum(v), self.client_axis), msums
             )
-            metrics = {
-                "train_loss": msums["loss_sum"]
-                / jnp.maximum(msums["count"], 1.0),
-                "train_acc": msums["correct"]
-                / jnp.maximum(msums["count"], 1.0),
-            }
-            return new_variables, new_opt_state, metrics
+            fin = finalize_sums(reduced)
+            metrics = {"train_loss": fin["loss"], "train_acc": fin["acc"]}
+            return new_state, metrics
 
-        new_variables, new_opt_state, metrics = shard_map(
+        new_state, metrics = shard_map(
             shard_fn,
             mesh=self.mesh,
-            in_specs=(rep, rep, cspec, cspec, cspec, rep, rep),
-            out_specs=(rep, rep, rep),
+            in_specs=(rep, cspec, cspec, cspec, rep, rep),
+            out_specs=(rep, rep),
             check_vma=False,
-        )(
-            state.variables,
-            state.opt_state,
-            idx_rows,
-            mask_rows,
-            ckeys,
-            arrays.x,
-            arrays.y,
-        )
-        new_state = ServerState(
-            variables=new_variables,
-            opt_state=new_opt_state,
-            momentum=state.momentum,
-            round=state.round + 1,
-        )
+        )(state, idx_rows, mask_rows, ckeys, arrays.x, arrays.y)
         return new_state, metrics
