@@ -1,0 +1,167 @@
+"""Actor-based distributed FedAvg over the message-passing runtime.
+
+Redesign of ``fedml_api/distributed/fedavg`` (5-file pattern:
+``FedAvgAPI.py`` init + rank split, ``FedAVGAggregator``, ``FedAVGTrainer``,
+``FedAvgServerManager``/``FedAvgClientManager``, ``message_define.py``).
+The actor shell is for TRUE cross-process deployments (multi-host DCN);
+compute inside each actor is the same jitted local update as the compiled
+simulator, so the math is identical to :class:`FedAvgSim` by construction.
+
+Topology (reference ``FedAvgAPI.py:36-66``): rank 0 = server, rank i>=1
+trains the partition of client ``cohort[i-1]`` each round.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.config import ExperimentConfig
+from fedml_tpu.core import tree as T
+from fedml_tpu.core.manager import ClientManager, ServerManager
+from fedml_tpu.core.message import (
+    KEY_CLIENT_INDEX,
+    KEY_MODEL_PARAMS,
+    KEY_NUM_SAMPLES,
+    KEY_ROUND,
+    MSG_TYPE_C2S_RESULT,
+    MSG_TYPE_S2C_SYNC_MODEL,
+    Message,
+)
+from fedml_tpu.core.transport.base import BaseTransport
+from fedml_tpu.data.federated import FederatedData
+from fedml_tpu.algorithms.base import build_local_update, make_task
+from fedml_tpu.models.base import FedModel
+
+
+class FedAvgServerActor(ServerManager):
+    """Rank-0 aggregator (reference ``FedAVGServerManager`` +
+    ``FedAVGAggregator``)."""
+
+    def __init__(
+        self,
+        size: int,
+        transport: BaseTransport,
+        model: FedModel,
+        cfg: ExperimentConfig,
+        num_clients: int,
+        on_round_done: Callable[[int, dict], None] | None = None,
+    ):
+        super().__init__(0, size, transport)
+        self.cfg = cfg
+        self.num_clients = num_clients
+        self.model = model
+        self.variables = model.init(jax.random.key(cfg.seed))
+        self.round_idx = 0
+        self._results: dict[int, tuple[dict, float]] = {}
+        self._lock = threading.Lock()
+        self.on_round_done = on_round_done
+        self.done = threading.Event()
+        self.register_message_receive_handler(
+            MSG_TYPE_C2S_RESULT, self._handle_result
+        )
+
+    def _sample(self) -> np.ndarray:
+        """Seeded cohort sampling (reference ``client_sampling``,
+        ``FedAVGAggregator.py:90-98``)."""
+        n_workers = self.size - 1
+        if n_workers >= self.num_clients:
+            return np.arange(self.num_clients)
+        rng = np.random.default_rng(self.round_idx)
+        return rng.choice(self.num_clients, n_workers, replace=False)
+
+    def start_round(self) -> None:
+        cohort = self._sample()
+        host_vars = jax.tree.map(np.asarray, self.variables)
+        self.broadcast(
+            MSG_TYPE_S2C_SYNC_MODEL,
+            lambda r: {
+                KEY_MODEL_PARAMS: host_vars,
+                KEY_CLIENT_INDEX: int(cohort[r - 1]),
+                KEY_ROUND: self.round_idx,
+            },
+        )
+
+    def _handle_result(self, msg: Message) -> None:
+        with self._lock:
+            self._results[msg.sender] = (
+                msg.get(KEY_MODEL_PARAMS),
+                float(msg.get(KEY_NUM_SAMPLES)),
+            )
+            if len(self._results) < self.size - 1:
+                return
+            results = self._results
+            self._results = {}
+        # all received: aggregate (reference
+        # handle_message_receive_model_from_client, FedAvgServerManager.py:45-82)
+        stacked = T.tree_stack([v for v, _ in results.values()])
+        weights = jnp.asarray([n for _, n in results.values()])
+        self.variables = T.tree_weighted_mean(stacked, weights)
+        self.round_idx += 1
+        if self.on_round_done is not None:
+            self.on_round_done(self.round_idx, {"num_results": len(results)})
+        if self.round_idx >= self.cfg.fed.num_rounds:
+            self.done.set()
+            self.finish_all()
+        else:
+            self.start_round()
+
+
+class FedAvgClientActor(ClientManager):
+    """Rank>=1 worker (reference ``FedAVGClientManager`` +
+    ``FedAVGTrainer``)."""
+
+    def __init__(
+        self,
+        rank: int,
+        size: int,
+        transport: BaseTransport,
+        model: FedModel,
+        data: FederatedData,
+        cfg: ExperimentConfig,
+    ):
+        super().__init__(rank, size, transport)
+        self.cfg = cfg
+        self.model = model
+        self.arrays = data.to_arrays(pad_multiple=cfg.data.batch_size)
+        max_n = self.arrays.max_client_samples
+        batch = min(cfg.data.batch_size, max_n)
+        task = make_task(data.task)
+        self._local_update = jax.jit(
+            build_local_update(model, task, cfg.train, batch, max_n)
+        )
+        self.root_key = jax.random.key(cfg.seed)
+        self.register_message_receive_handler(
+            MSG_TYPE_S2C_SYNC_MODEL, self._handle_sync
+        )
+
+    def _handle_sync(self, msg: Message) -> None:
+        client_idx = int(msg.get(KEY_CLIENT_INDEX))
+        round_idx = int(msg.get(KEY_ROUND))
+        variables = jax.tree.map(jnp.asarray, msg.get(KEY_MODEL_PARAMS))
+        rng = jax.random.fold_in(
+            jax.random.fold_in(self.root_key, round_idx), client_idx
+        )
+        new_vars, n_k, _ = self._local_update(
+            variables,
+            self.arrays.idx[client_idx],
+            self.arrays.mask[client_idx],
+            self.arrays.x,
+            self.arrays.y,
+            rng,
+        )
+        self.send_message(
+            Message(
+                MSG_TYPE_C2S_RESULT,
+                self.rank,
+                0,
+                {
+                    KEY_MODEL_PARAMS: jax.tree.map(np.asarray, new_vars),
+                    KEY_NUM_SAMPLES: float(n_k),
+                },
+            )
+        )

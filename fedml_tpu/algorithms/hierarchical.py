@@ -1,0 +1,129 @@
+"""Hierarchical FL: cloud -> edge-group -> client two-level aggregation.
+
+Redesign of the reference's ``fedml_api/standalone/hierarchical_fl``
+(``trainer.py:8-70``: random client grouping, nested
+global-round x group-round x epoch loop; ``group.py:24-46`` group
+aggregation) and the cross-silo 2-level pattern.
+
+TPU formulation: clients are grouped into equal-size groups stacked as
+``[G, C_g, ...]``. A global round = ``group_comm_round`` inner FedAvg
+rounds vmapped over groups (each group aggregates only its own clients),
+then a weighted mean over groups. On a mesh this maps to 2-level psum —
+intra-submesh then inter-submesh (see SURVEY.md §2.7).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.config import ExperimentConfig
+from fedml_tpu.core import random as R
+from fedml_tpu.core import tree as T
+from fedml_tpu.data.federated import FederatedData
+from fedml_tpu.algorithms.base import (
+    build_evaluator,
+    build_local_update,
+    finalize_sums,
+    make_task,
+)
+from fedml_tpu.models.base import FedModel
+
+Pytree = Any
+
+
+class HierState(NamedTuple):
+    variables: Pytree
+    round: jax.Array
+
+
+class HierarchicalFedAvg:
+    """Two-level FedAvg (reference ``hierarchical_fl/trainer.py:43-70``)."""
+
+    def __init__(
+        self,
+        model: FedModel,
+        data: FederatedData,
+        cfg: ExperimentConfig,
+        num_groups: int = 2,
+        group_comm_round: int = 1,
+    ):
+        self.model = model
+        self.cfg = cfg
+        self.task = make_task(data.task)
+        self.arrays = data.to_arrays(pad_multiple=cfg.data.batch_size)
+        n = self.arrays.num_clients
+        assert n % num_groups == 0, (n, num_groups)
+        self.num_groups = num_groups
+        self.group_size = n // num_groups
+        self.group_comm_round = group_comm_round
+        # random grouping, fixed for the run (trainer.py:13-21)
+        rng = np.random.default_rng(cfg.seed)
+        self.grouping = jnp.asarray(
+            rng.permutation(n).reshape(num_groups, self.group_size)
+        )
+        max_n = self.arrays.max_client_samples
+        self.batch_size = min(cfg.data.batch_size, max_n)
+        self.local_update = build_local_update(
+            model, self.task, cfg.train, self.batch_size, max_n
+        )
+        self.evaluator = build_evaluator(model, self.task)
+        self.root_key = jax.random.key(cfg.seed)
+        self._round_fn = jax.jit(self._round, donate_argnums=(0,))
+
+    def init(self) -> HierState:
+        variables = self.model.init(
+            jax.random.fold_in(self.root_key, 0x7FFFFFFF)
+        )
+        return HierState(variables, jnp.asarray(0, jnp.int32))
+
+    def _round(self, state: HierState, arrays):
+        rkey = R.round_key(self.root_key, state.round)
+
+        def group_train(gvars, client_ids, gkey):
+            """group_comm_round inner FedAvg rounds over this group's
+            clients (reference group.py:24-46)."""
+
+            def inner(carry, r):
+                gvars, _ = carry
+                ckeys = jax.vmap(
+                    lambda c: R.client_key(jax.random.fold_in(gkey, r), c)
+                )(client_ids)
+                stacked, n_k, msums = jax.vmap(
+                    self.local_update, in_axes=(None, 0, 0, None, None, 0)
+                )(gvars, arrays.idx[client_ids], arrays.mask[client_ids],
+                  arrays.x, arrays.y, ckeys)
+                agg = T.tree_weighted_mean(stacked, n_k)
+                return (agg, jnp.sum(n_k)), msums
+
+            (gvars, g_n), msums = jax.lax.scan(
+                inner, (gvars, jnp.asarray(0.0)),
+                jnp.arange(self.group_comm_round),
+            )
+            return gvars, g_n, jax.tree.map(lambda v: jnp.sum(v), msums)
+
+        gkeys = jax.vmap(lambda g: jax.random.fold_in(rkey, g))(
+            jnp.arange(self.num_groups)
+        )
+        g_vars, g_n, msums = jax.vmap(group_train, in_axes=(None, 0, 0))(
+            state.variables, self.grouping, gkeys
+        )
+        new_vars = T.tree_weighted_mean(g_vars, g_n)
+        reduced = jax.tree.map(jnp.sum, msums)
+        fin = finalize_sums(reduced)
+        return (
+            HierState(new_vars, state.round + 1),
+            {"train_loss": fin["loss"], "train_acc": fin["acc"]},
+        )
+
+    def run_round(self, state):
+        return self._round_fn(state, self.arrays)
+
+    def evaluate_global(self, state) -> dict:
+        m = self.evaluator(
+            state.variables, self.arrays.test_x, self.arrays.test_y
+        )
+        return {k: float(v) for k, v in m.items()}

@@ -1,0 +1,100 @@
+"""Actor base classes: handler-registry managers over a transport.
+
+Reference: ``ClientManager`` / ``ServerManager``
+(``fedml_core/distributed/client/client_manager.py:21``,
+``server/server_manager.py:15``): construct a backend by name, register as
+Observer, dispatch inbound messages by ``msg_type`` to registered handlers.
+``finish()`` there is ``MPI.COMM_WORLD.Abort()`` (``client_manager.py:92-93``);
+here it's a cooperative FINISH broadcast + transport stop.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from fedml_tpu.core.message import MSG_TYPE_FINISH, Message
+from fedml_tpu.core.transport.base import BaseTransport
+
+Handler = Callable[[Message], None]
+
+
+def create_transport(
+    backend: str,
+    rank: int,
+    *,
+    hub=None,
+    ip_config: dict[int, tuple[str, int]] | None = None,
+) -> BaseTransport:
+    """Backend dispatch by name (reference ``client_manager.py:28-50``:
+    backend in {MPI, MQTT, MQTT_S3, GRPC, TRPC}; here {LOOPBACK, TCP,
+    GRPC})."""
+    backend = backend.upper()
+    if backend == "LOOPBACK":
+        assert hub is not None, "loopback needs a shared LoopbackHub"
+        return hub.create(rank)
+    if backend == "TCP":
+        from fedml_tpu.core.transport.tcp import TcpTransport
+
+        assert ip_config is not None
+        return TcpTransport(rank, ip_config)
+    if backend == "GRPC":
+        from fedml_tpu.core.transport.grpc_transport import GrpcTransport
+
+        assert ip_config is not None
+        return GrpcTransport(rank, ip_config)
+    raise ValueError(f"unknown backend: {backend}")
+
+
+class Manager:
+    """Common actor machinery (both sides)."""
+
+    def __init__(self, rank: int, size: int, transport: BaseTransport):
+        self.rank = rank
+        self.size = size
+        self.transport = transport
+        self._handlers: dict[int, Handler] = {}
+        transport.add_observer(self)
+        self.register_message_receive_handler(
+            MSG_TYPE_FINISH, lambda msg: self.finish()
+        )
+
+    def register_message_receive_handler(
+        self, msg_type: int, handler: Handler
+    ) -> None:
+        self._handlers[msg_type] = handler
+
+    def receive_message(self, msg_type: int, msg: Message) -> None:
+        handler = self._handlers.get(msg_type)
+        if handler is None:
+            raise KeyError(
+                f"rank {self.rank}: no handler for msg_type {msg_type}"
+            )
+        handler(msg)
+
+    def send_message(self, msg: Message) -> None:
+        self.transport.send_message(msg)
+
+    def run(self) -> None:
+        self.transport.handle_receive_message()
+
+    def finish(self) -> None:
+        self.transport.stop()
+
+
+class ServerManager(Manager):
+    """Rank-0 actor (reference ``server_manager.py:15``)."""
+
+    def broadcast(self, msg_type: int, payload_fn) -> None:
+        """Send ``Message(msg_type, 0, r, payload_fn(r))`` to every client
+        rank 1..size-1."""
+        for r in range(1, self.size):
+            self.send_message(Message(msg_type, self.rank, r, payload_fn(r)))
+
+    def finish_all(self) -> None:
+        for r in range(1, self.size):
+            self.send_message(Message(MSG_TYPE_FINISH, self.rank, r, {}))
+        self.finish()
+
+
+class ClientManager(Manager):
+    """Rank>=1 actor (reference ``client_manager.py:21``)."""

@@ -1,0 +1,66 @@
+"""Message envelope for the cross-process runtime.
+
+Mirrors the reference's ``Message``
+(``fedml_core/distributed/communication/message.py:5-81``): a typed envelope
+``(msg_type, sender, receiver)`` plus arbitrary params (model pytrees ride
+as numpy arrays). The reference pickles messages over MPI
+(``mpi_send_thread.py:22-27``) and JSON-encodes them over gRPC/MQTT; here
+one codec (pickle protocol 5, zero-copy buffers for large arrays) serves
+every transport, and device arrays are converted to numpy at the transport
+boundary — device->host transfer happens exactly once, at send.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import pickle
+from typing import Any
+
+import jax
+import numpy as np
+
+
+# Well-known message types (reference message_define.py files use small int
+# enums per algorithm; we reserve a shared space for the built-in flows).
+MSG_TYPE_S2C_INIT = 1
+MSG_TYPE_S2C_SYNC_MODEL = 2
+MSG_TYPE_C2S_RESULT = 3
+MSG_TYPE_FINISH = 4
+
+# Well-known payload keys (reference Message.MSG_ARG_KEY_*)
+KEY_MODEL_PARAMS = "model_params"
+KEY_NUM_SAMPLES = "num_samples"
+KEY_CLIENT_INDEX = "client_index"
+KEY_ROUND = "round_idx"
+
+
+@dataclasses.dataclass
+class Message:
+    msg_type: int
+    sender: int
+    receiver: int
+    payload: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def get(self, key: str, default=None):
+        return self.payload.get(key, default)
+
+    def add(self, key: str, value: Any) -> "Message":
+        self.payload[key] = value
+        return self
+
+    def host_copy(self) -> "Message":
+        """Convert any device arrays in the payload to numpy (one D2H)."""
+        payload = jax.tree.map(
+            lambda v: np.asarray(v) if isinstance(v, jax.Array) else v,
+            self.payload,
+        )
+        return Message(self.msg_type, self.sender, self.receiver, payload)
+
+    def encode(self) -> bytes:
+        return pickle.dumps(self.host_copy(), protocol=5)
+
+    @staticmethod
+    def decode(data: bytes) -> "Message":
+        msg = pickle.loads(data)
+        assert isinstance(msg, Message)
+        return msg

@@ -1,0 +1,87 @@
+"""Topology managers for decentralized FL.
+
+Re-designs the reference's topology layer
+(``fedml_core/distributed/topology/{base,symmetric,asymmetric}_topology_manager.py``):
+a ring + random-link ("Watts-Strogatz-like") neighbor graph with
+row-normalized mixing weights. The TPU-native addition: the topology is
+exported as a dense ``[N, N]`` mixing matrix so a full gossip round is one
+matmul over the client axis (MXU work), instead of per-neighbor message
+sends.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class SymmetricTopologyManager:
+    """Undirected ring with `neighbor_num` nearest neighbors plus optional
+    random extra links; row-normalized symmetric mixing weights (reference
+    ``symmetric_topology_manager.py:21-52``)."""
+
+    def __init__(self, n: int, neighbor_num: int = 2, extra_links: int = 0,
+                 seed: int = 0):
+        self.n = n
+        self.neighbor_num = min(neighbor_num, n - 1)
+        self.extra_links = extra_links
+        self.seed = seed
+        self.topology = self._generate()
+
+    def _generate(self) -> np.ndarray:
+        n, k = self.n, self.neighbor_num
+        adj = np.eye(n, dtype=np.float64)
+        for i in range(n):
+            for d in range(1, k // 2 + 1):
+                adj[i, (i + d) % n] = 1.0
+                adj[i, (i - d) % n] = 1.0
+        rng = np.random.default_rng(self.seed)
+        for _ in range(self.extra_links):
+            i, j = rng.integers(0, n, 2)
+            if i != j:
+                adj[i, j] = adj[j, i] = 1.0
+        # symmetrize then row-normalize (equal weights over neighbors+self)
+        adj = np.maximum(adj, adj.T)
+        return adj / adj.sum(axis=1, keepdims=True)
+
+    def get_in_neighbor_idx_list(self, node: int) -> list[int]:
+        return [
+            j for j in range(self.n) if self.topology[j, node] > 0 and j != node
+        ]
+
+    def get_out_neighbor_idx_list(self, node: int) -> list[int]:
+        return [
+            j for j in range(self.n) if self.topology[node, j] > 0 and j != node
+        ]
+
+    def get_in_neighbor_weights(self, node: int) -> np.ndarray:
+        return self.topology[:, node]
+
+    def get_out_neighbor_weights(self, node: int) -> np.ndarray:
+        return self.topology[node]
+
+    def mixing_matrix(self) -> np.ndarray:
+        """Dense row-stochastic [N, N] matrix W; gossip mixing is
+        ``stacked_params' = W @ stacked_params``."""
+        return self.topology
+
+
+class AsymmetricTopologyManager(SymmetricTopologyManager):
+    """Directed variant: each node drops a random subset of out-links, so
+    in/out neighborhoods differ (reference
+    ``asymmetric_topology_manager.py:7``)."""
+
+    def __init__(self, n: int, neighbor_num: int = 4, out_drop: int = 1,
+                 seed: int = 0):
+        self.out_drop = out_drop
+        super().__init__(n, neighbor_num, 0, seed)
+
+    def _generate(self) -> np.ndarray:
+        base = super()._generate()
+        rng = np.random.default_rng(self.seed + 1)
+        adj = (base > 0).astype(np.float64)
+        for i in range(self.n):
+            outs = [j for j in range(self.n) if adj[i, j] > 0 and j != i]
+            rng.shuffle(outs)
+            for j in outs[: self.out_drop]:
+                adj[i, j] = 0.0
+        return adj / adj.sum(axis=1, keepdims=True)

@@ -1,0 +1,21 @@
+"""Transports for the cross-process runtime.
+
+The reference ships five backends behind one 4-method contract
+(``fedml_core/distributed/communication/base_com_manager.py:7``): MPI, gRPC,
+Torch-RPC, MQTT, MQTT+S3 (SURVEY.md §2.8). The TPU build keeps the contract
+and provides:
+
+- ``LoopbackTransport`` — in-memory, for tests (the reference lacks this);
+- ``TcpTransport``     — length-prefixed frames over sockets (DCN-class
+  cross-host control plane);
+- ``GrpcTransport``    — grpc bytes-RPC (no protoc needed).
+
+Bulk tensor traffic between chips should ride ICI collectives
+(:mod:`fedml_tpu.parallel`), not these transports — they carry control
+messages and cross-host (DCN) model blobs only, mirroring the reference's
+MQTT(control)+S3(data) split.
+"""
+
+from fedml_tpu.core.transport.base import BaseTransport, Observer
+from fedml_tpu.core.transport.loopback import LoopbackHub, LoopbackTransport
+from fedml_tpu.core.transport.tcp import TcpTransport

@@ -1,0 +1,68 @@
+"""Transport contract.
+
+Reference: ``BaseCommunicationManager``
+(``fedml_core/distributed/communication/base_com_manager.py:7-27``) and
+``Observer`` (``observer.py:4-8``). The reference runs per-backend
+send/receive daemon threads with a 0.3s poll loop
+(``mpi/com_manager.py:71-79``) and kills them via
+``PyThreadState_SetAsyncExc`` (``mpi_receive_thread.py:44-50``); here every
+transport drains into one thread-safe inbox and a single dispatch loop with
+cooperative shutdown — no async thread kills (SURVEY.md §5.2).
+"""
+
+from __future__ import annotations
+
+import abc
+import queue
+import threading
+from typing import Protocol
+
+from fedml_tpu.core.message import Message
+
+
+class Observer(Protocol):
+    def receive_message(self, msg_type: int, msg: Message) -> None: ...
+
+
+class BaseTransport(abc.ABC):
+    """4-method contract + shared inbox/dispatch machinery."""
+
+    def __init__(self, rank: int):
+        self.rank = rank
+        self._observers: list[Observer] = []
+        self._inbox: queue.Queue[Message | None] = queue.Queue()
+        self._stopped = threading.Event()
+
+    # -- to implement ------------------------------------------------------
+    @abc.abstractmethod
+    def send_message(self, msg: Message) -> None: ...
+
+    def start(self) -> None:  # start background receivers if any
+        pass
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self._inbox.put(None)  # wake the dispatch loop
+
+    # -- shared ------------------------------------------------------------
+    def add_observer(self, obs: Observer) -> None:
+        self._observers.append(obs)
+
+    def deliver(self, msg: Message) -> None:
+        """Called by receiver machinery (or peers, for loopback)."""
+        self._inbox.put(msg)
+
+    def handle_receive_message(self, timeout: float | None = None) -> None:
+        """Blocking dispatch loop (reference
+        ``MpiCommunicationManager.handle_receive_message``,
+        ``com_manager.py:71-79`` — but event-driven, no 0.3s poll)."""
+        self.start()
+        while not self._stopped.is_set():
+            try:
+                msg = self._inbox.get(timeout=timeout)
+            except queue.Empty:
+                return
+            if msg is None:
+                break
+            for obs in self._observers:
+                obs.receive_message(msg.msg_type, msg)

@@ -1,0 +1,86 @@
+"""gRPC transport without protoc: generic bytes-RPC.
+
+Reference: ``GRPCCommManager``
+(``fedml_core/distributed/communication/gRPC/grpc_comm_manager.py:22-98``) —
+a proto service with one ``sendMessage`` RPC, JSON payloads, 1 GB message
+cap. Here the service is registered dynamically
+(``grpc.method_handlers_generic_handler`` with identity serializers), the
+payload is the shared binary codec, and the same 1 GB cap is applied.
+"""
+
+from __future__ import annotations
+
+from concurrent import futures
+
+from fedml_tpu.core.message import Message
+from fedml_tpu.core.transport.base import BaseTransport
+
+_SERVICE = "fedml_tpu.Comm"
+_METHOD = "SendMessage"
+MAX_MESSAGE_BYTES = 1 << 30  # reference grpc_comm_manager.py:36-40
+
+
+class GrpcTransport(BaseTransport):
+    def __init__(self, rank: int, ip_config: dict[int, tuple[str, int]]):
+        super().__init__(rank)
+        import grpc  # lazy: keep core importable without grpcio
+
+        self._grpc = grpc
+        self.ip_config = ip_config
+        self._server = None
+        self._channels: dict[int, object] = {}
+
+    def start(self) -> None:
+        if self._server is not None:
+            return
+        grpc = self._grpc
+
+        def handler(request: bytes, context) -> bytes:
+            self.deliver(Message.decode(request))
+            return b""
+
+        generic = grpc.method_handlers_generic_handler(
+            _SERVICE,
+            {
+                _METHOD: grpc.unary_unary_rpc_method_handler(
+                    handler,
+                    request_deserializer=None,
+                    response_serializer=None,
+                )
+            },
+        )
+        opts = [
+            ("grpc.max_send_message_length", MAX_MESSAGE_BYTES),
+            ("grpc.max_receive_message_length", MAX_MESSAGE_BYTES),
+        ]
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=4), options=opts
+        )
+        self._server.add_generic_rpc_handlers((generic,))
+        host, port = self.ip_config[self.rank]
+        self._server.add_insecure_port(f"{host}:{port}")
+        self._server.start()
+
+    def _stub(self, rank: int):
+        grpc = self._grpc
+        ch = self._channels.get(rank)
+        if ch is None:
+            host, port = self.ip_config[rank]
+            opts = [
+                ("grpc.max_send_message_length", MAX_MESSAGE_BYTES),
+                ("grpc.max_receive_message_length", MAX_MESSAGE_BYTES),
+            ]
+            ch = grpc.insecure_channel(f"{host}:{port}", options=opts)
+            self._channels[rank] = ch
+        return ch.unary_unary(f"/{_SERVICE}/{_METHOD}")
+
+    def send_message(self, msg: Message) -> None:
+        self._stub(msg.receiver)(msg.encode())
+
+    def stop(self) -> None:
+        super().stop()
+        if self._server is not None:
+            self._server.stop(grace=0.5)
+        for ch in self._channels.values():
+            ch.close()
+        self._channels.clear()

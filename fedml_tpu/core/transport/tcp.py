@@ -1,0 +1,108 @@
+"""TCP socket transport: length-prefixed message frames.
+
+The DCN-class control-plane transport (reference analog: the gRPC backend,
+``fedml_core/distributed/communication/gRPC/grpc_comm_manager.py:22-98`` —
+each process runs a server, send opens a channel to ``ip_config[receiver]``).
+Here: each rank runs one accept loop; sends use pooled persistent
+connections; frames are ``8-byte big-endian length || pickled Message``.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+
+from fedml_tpu.core.message import Message
+from fedml_tpu.core.transport.base import BaseTransport
+
+_HDR = struct.Struct(">Q")
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+class TcpTransport(BaseTransport):
+    def __init__(self, rank: int, ip_config: dict[int, tuple[str, int]]):
+        """``ip_config``: rank -> (host, port) for every participant
+        (reference ``ip_config_utils.py`` CSV tables)."""
+        super().__init__(rank)
+        self.ip_config = ip_config
+        self._server: socket.socket | None = None
+        self._conns: dict[int, socket.socket] = {}
+        self._lock = threading.Lock()
+        self._threads: list[threading.Thread] = []
+
+    # -- receive side ------------------------------------------------------
+    def start(self) -> None:
+        if self._server is not None:
+            return
+        host, port = self.ip_config[self.rank]
+        srv = socket.create_server((host, port), reuse_port=False)
+        srv.settimeout(0.5)
+        self._server = srv
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def _accept_loop(self) -> None:
+        assert self._server is not None
+        while not self._stopped.is_set():
+            try:
+                conn, _ = self._server.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            t = threading.Thread(
+                target=self._read_loop, args=(conn,), daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+
+    def _read_loop(self, conn: socket.socket) -> None:
+        with conn:
+            while not self._stopped.is_set():
+                hdr = _recv_exact(conn, _HDR.size)
+                if hdr is None:
+                    return
+                (length,) = _HDR.unpack(hdr)
+                data = _recv_exact(conn, length)
+                if data is None:
+                    return
+                self.deliver(Message.decode(data))
+
+    # -- send side ---------------------------------------------------------
+    def _conn_to(self, rank: int) -> socket.socket:
+        with self._lock:
+            sock = self._conns.get(rank)
+            if sock is None:
+                host, port = self.ip_config[rank]
+                sock = socket.create_connection((host, port), timeout=30)
+                self._conns[rank] = sock
+            return sock
+
+    def send_message(self, msg: Message) -> None:
+        data = msg.encode()
+        sock = self._conn_to(msg.receiver)
+        with self._lock:
+            sock.sendall(_HDR.pack(len(data)) + data)
+
+    def stop(self) -> None:
+        super().stop()
+        if self._server is not None:
+            self._server.close()
+        with self._lock:
+            for sock in self._conns.values():
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            self._conns.clear()
