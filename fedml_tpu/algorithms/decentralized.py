@@ -66,6 +66,11 @@ class DecentralizedSim:
         n = self.arrays.num_clients
         topology = topology or SymmetricTopologyManager(n, neighbor_num=2)
         self.W = jnp.asarray(topology.mixing_matrix(), jnp.float32)
+        # Push-sum requires a COLUMN-stochastic mixing matrix (mass each node
+        # pushes out sums to 1) so that sum(w) is conserved and the w-vector
+        # actually tracks the stationary bias; the row-stochastic W used for
+        # DSGD would leave w == ones and degenerate push-sum into DSGD.
+        self.P = self.W / jnp.maximum(self.W.sum(axis=0, keepdims=True), 1e-12)
         max_n = self.arrays.max_client_samples
         self.batch_size = min(cfg.data.batch_size, max_n)
         self.local_update = build_local_update(
@@ -112,15 +117,17 @@ class DecentralizedSim:
 
         if self.method == "pushsum":
             biased = scale(new_z, state.push_weights)
-            new_w = self.W @ state.push_weights
+            new_w = self.P @ state.push_weights
+            mix_mat = self.P
         else:
             biased = new_z
             new_w = state.push_weights
+            mix_mat = self.W
 
         # gossip mixing: one matmul per leaf over the client axis
         def mix(leaf):
             flat = leaf.reshape(n, -1)
-            return (self.W @ flat).reshape(leaf.shape)
+            return (mix_mat @ flat).reshape(leaf.shape)
 
         mixed = jax.tree.map(mix, biased)
 

@@ -67,7 +67,10 @@ class FedAvgServerActor(ServerManager):
 
     def _sample(self) -> np.ndarray:
         """Seeded cohort sampling (reference ``client_sampling``,
-        ``FedAVGAggregator.py:90-98``)."""
+        ``FedAVGAggregator.py:90-98``). In the distributed path the cohort
+        size is the worker count, as in the reference (one MPI rank per
+        sampled client, ``FedAvgAPI.py:36-66``); if there are more workers
+        than clients the assignment wraps so every worker gets a client."""
         n_workers = self.size - 1
         if n_workers >= self.num_clients:
             return np.arange(self.num_clients)
@@ -81,7 +84,7 @@ class FedAvgServerActor(ServerManager):
             MSG_TYPE_S2C_SYNC_MODEL,
             lambda r: {
                 KEY_MODEL_PARAMS: host_vars,
-                KEY_CLIENT_INDEX: int(cohort[r - 1]),
+                KEY_CLIENT_INDEX: int(cohort[(r - 1) % len(cohort)]),
                 KEY_ROUND: self.round_idx,
             },
         )

@@ -1,0 +1,450 @@
+"""Compiled building blocks for the GAN/KD algorithm family.
+
+The reference trains GANs with a per-batch python loop of alternating
+generator/discriminator optimizer steps
+(``fedml_api/standalone/fedgdkd/ac_gan_model_trainer.py:52-120`` and the
+logsumexp variant ``fedml_api/standalone/fedgdkd/model_trainer.py:23-113``).
+Here each client's whole adversarial training run is ONE ``lax.scan`` over
+steps (vmappable across the cohort), and the distillation phase is another
+scan — so a round of FedGDKD compiles to a single XLA program.
+
+Two adversarial modes:
+
+- ``acgan``: BCE on a dedicated validity head + CE auxiliary classifier
+  (reference ``ac_gan_model_trainer.py:52-120``). Requires a discriminator
+  module with a ``discriminator=True`` call path (e.g.
+  :class:`fedml_tpu.models.gan.ACGANDiscriminator`).
+- ``ssgan``: the semi-supervised logsumexp formulation where the
+  discriminator IS the client's K-way classifier
+  (``fedgdkd/model_trainer.py:23-113``): real/fake confidence is
+  ``logsumexp(logits)``; adversarial terms use ``softplus``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from fedml_tpu.algorithms import kd as KD
+from fedml_tpu.algorithms.base import make_client_optimizer
+from fedml_tpu.config import GanConfig, TrainConfig
+from fedml_tpu.models.base import FedModel
+from fedml_tpu.models.gan import GanModel
+
+Pytree = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class DiscHandle:
+    """Functional handle on a discriminator/classifier module that may have
+    an auxiliary validity head (``cnn_custom.py:36-41``)."""
+
+    module: Any
+    has_batch_stats: bool = True
+    has_dropout: bool = True
+    has_validity_head: bool = False
+
+    @classmethod
+    def from_fed_model(cls, m: FedModel) -> "DiscHandle":
+        return cls(
+            module=m.module,
+            has_batch_stats=m.has_batch_stats,
+            has_dropout=m.has_dropout,
+            has_validity_head=False,
+        )
+
+    def init(self, rng: jax.Array, input_shape) -> Pytree:
+        dummy = jnp.zeros((1,) + tuple(input_shape), jnp.float32)
+        kwargs = {"discriminator": True} if self.has_validity_head else {}
+        return self.module.init({"params": rng}, dummy, train=False, **kwargs)
+
+    def _rngs(self, rng):
+        return {"dropout": rng} if self.has_dropout else None
+
+    def apply_train(self, variables, x, rng, validity: bool = False):
+        kwargs = {"discriminator": True} if validity else {}
+        if self.has_batch_stats:
+            out, mutated = self.module.apply(
+                variables, x, train=True, rngs=self._rngs(rng),
+                mutable=["batch_stats"], **kwargs,
+            )
+            return out, {**variables, **mutated}
+        out = self.module.apply(
+            variables, x, train=True, rngs=self._rngs(rng), **kwargs
+        )
+        return out, variables
+
+    def apply_eval(self, variables, x, validity: bool = False):
+        kwargs = {"discriminator": True} if validity else {}
+        return self.module.apply(variables, x, train=False, **kwargs)
+
+
+def make_gen_optimizer(cfg: GanConfig) -> optax.GradientTransformation:
+    """Generator optimizer (reference ``gen_optimizer``/``gen_lr`` args,
+    ``main_fedgdkd.py:40-45``)."""
+    if cfg.gen_optimizer == "adam":
+        return optax.adam(cfg.gen_lr)
+    if cfg.gen_optimizer == "sgd":
+        return optax.sgd(cfg.gen_lr)
+    raise ValueError(f"unknown gen optimizer: {cfg.gen_optimizer}")
+
+
+def _masked_mean(v, w):
+    return jnp.sum(v * w) / jnp.maximum(jnp.sum(w), 1.0)
+
+
+def _ce(logits, labels, w):
+    ce = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    return _masked_mean(ce, w)
+
+
+# ---------------------------------------------------------------------------
+# Adversarial losses
+# ---------------------------------------------------------------------------
+
+
+def generator_loss_ssgan(cls_logits_gen, gen_labels, w):
+    """errG of ``fedgdkd/model_trainer.py:44-61``: aux = CE(logits, label);
+    adv = mean(-logz + softplus(logz)); errG = (adv + aux) / 2."""
+    logz = jax.nn.logsumexp(cls_logits_gen, axis=-1)
+    aux = _ce(cls_logits_gen, gen_labels, w)
+    adv = _masked_mean(-logz + jax.nn.softplus(logz), w)
+    return 0.5 * (adv + aux)
+
+
+def discriminator_loss_ssgan(cls_fake, gen_labels, cls_real, real_labels, w):
+    """errD of ``fedgdkd/model_trainer.py:63-104``."""
+    logz_f = jax.nn.logsumexp(cls_fake, axis=-1)
+    fake_half = 0.5 * (
+        _ce(cls_fake, gen_labels, w)
+        + _masked_mean(jax.nn.softplus(logz_f), w)
+    )
+    logz_r = jax.nn.logsumexp(cls_real, axis=-1)
+    real_half = 0.5 * (
+        _ce(cls_real, real_labels, w)
+        + _masked_mean(-logz_r + jax.nn.softplus(logz_r), w)
+    )
+    return fake_half + real_half
+
+
+def _bce_logits(v_logit, target, w):
+    # sigmoid+BCELoss == BCE-with-logits (reference applies Sigmoid in the
+    # module, cnn_custom.py:40, then BCELoss, ac_gan_model_trainer.py:57)
+    b = optax.sigmoid_binary_cross_entropy(v_logit[:, 0], target)
+    return _masked_mean(b, w)
+
+
+def generator_loss_acgan(cls_gen, v_gen, gen_labels, w):
+    """errG of ``ac_gan_model_trainer.py:85-97``."""
+    return 0.5 * (
+        _bce_logits(v_gen, jnp.ones(v_gen.shape[0]), w)
+        + _ce(cls_gen, gen_labels, w)
+    )
+
+
+def discriminator_loss_acgan(
+    cls_fake, v_fake, gen_labels, cls_real, v_real, real_labels, w
+):
+    """errD of ``ac_gan_model_trainer.py:99-116``."""
+    d_real = 0.5 * (
+        _bce_logits(v_real, jnp.ones(v_real.shape[0]), w)
+        + _ce(cls_real, real_labels, w)
+    )
+    d_fake = 0.5 * (
+        _bce_logits(v_fake, jnp.zeros(v_fake.shape[0]), w)
+        + _ce(cls_fake, gen_labels, w)
+    )
+    return 0.5 * (d_real + d_fake)
+
+
+# ---------------------------------------------------------------------------
+# The compiled adversarial local update
+# ---------------------------------------------------------------------------
+
+
+def build_gan_local_update(
+    gen: GanModel,
+    disc: DiscHandle,
+    train_cfg: TrainConfig,
+    gan_cfg: GanConfig,
+    batch_size: int,
+    max_n: int,
+    mode: str = "ssgan",
+):
+    """Build ``update(gen_vars, disc_vars, idx_row, mask_row, x, y, rng)``
+    -> ``(gen_vars, disc_vars, n_k, loss_sums)``.
+
+    One G step then one D step per batch, G first on fresh fakes, D on the
+    same fakes without grad flow to G — matching the reference's ordering
+    and ``.detach()`` (``ac_gan_model_trainer.py:80-116``).
+    """
+    assert mode in ("ssgan", "acgan"), mode
+    assert max_n % batch_size == 0
+    steps_per_epoch = max_n // batch_size
+    g_opt = make_gen_optimizer(gan_cfg)
+    d_opt = make_client_optimizer(train_cfg)
+
+    def g_loss_fn(g_params, g_static, d_vars, z, gen_labels, w, rng):
+        g_vars = {**g_static, "params": g_params}
+        fakes, new_g_vars = gen.apply_train(g_vars, z, gen_labels)
+        if mode == "ssgan":
+            out, _ = disc.apply_train(d_vars, fakes, rng)
+            loss = generator_loss_ssgan(out, gen_labels, w)
+        else:
+            (cls, val), _ = disc.apply_train(d_vars, fakes, rng, validity=True)
+            loss = generator_loss_acgan(cls, val, gen_labels, w)
+        return loss, (new_g_vars, fakes)
+
+    def d_loss_fn(d_params, d_static, fakes, gen_labels, x_b, y_b, w, rng):
+        d_vars = {**d_static, "params": d_params}
+        r1, r2 = jax.random.split(rng)
+        if mode == "ssgan":
+            cls_fake, d_vars1 = disc.apply_train(d_vars, fakes, r1)
+            cls_real, d_vars2 = disc.apply_train(d_vars1, x_b, r2)
+            loss = discriminator_loss_ssgan(cls_fake, gen_labels, cls_real, y_b, w)
+        else:
+            (cls_f, v_f), d_vars1 = disc.apply_train(
+                d_vars, fakes, r1, validity=True
+            )
+            (cls_r, v_r), d_vars2 = disc.apply_train(
+                d_vars1, x_b, r2, validity=True
+            )
+            loss = discriminator_loss_acgan(
+                cls_f, v_f, gen_labels, cls_r, v_r, y_b, w
+            )
+        return loss, d_vars2
+
+    g_grad = jax.value_and_grad(g_loss_fn, has_aux=True)
+    d_grad = jax.value_and_grad(d_loss_fn, has_aux=True)
+
+    def update(gen_vars, disc_vars, idx_row, mask_row, x, y, rng):
+        def epoch_body(carry, ekey):
+            g_vars, d_vars, g_os, d_os, sums = carry
+            perm = jax.random.permutation(ekey, max_n)
+            order = jnp.argsort(1.0 - mask_row[perm], stable=True)
+            perm = perm[order]
+
+            def step_body(carry2, step):
+                g_vars, d_vars, g_os, d_os, sums = carry2
+                take = jax.lax.dynamic_slice_in_dim(
+                    perm, step * batch_size, batch_size
+                )
+                b_idx = idx_row[take]
+                w_b = mask_row[take]
+                x_b = jnp.take(x, b_idx, axis=0)
+                y_b = jnp.take(y, b_idx, axis=0)
+                skey = jax.random.fold_in(ekey, step)
+                kz, kl, kg, kd_ = jax.random.split(skey, 4)
+
+                z = gen.sample_noise(kz, batch_size)
+                gen_labels = gen.sample_labels(kl, batch_size)
+
+                # --- G step (ac_gan_model_trainer.py:80-97) ---
+                g_params = g_vars["params"]
+                g_static = {k: v for k, v in g_vars.items() if k != "params"}
+                (g_loss, (new_g_vars, fakes)), g_grads = g_grad(
+                    g_params, g_static, d_vars, z, gen_labels, w_b, kg
+                )
+                g_updates, new_g_os = g_opt.update(g_grads, g_os, g_params)
+                new_g_params = optax.apply_updates(g_params, g_updates)
+                new_g_vars = {**new_g_vars, "params": new_g_params}
+
+                # --- D step on detached fakes (:99-116) ---
+                d_params = d_vars["params"]
+                d_static = {k: v for k, v in d_vars.items() if k != "params"}
+                (d_loss, new_d_vars), d_grads = d_grad(
+                    d_params, d_static, jax.lax.stop_gradient(fakes),
+                    gen_labels, x_b, y_b, w_b, kd_,
+                )
+                d_updates, new_d_os = d_opt.update(d_grads, d_os, d_params)
+                new_d_vars = {
+                    **new_d_vars,
+                    "params": optax.apply_updates(d_params, d_updates),
+                }
+
+                # fully-padded batch -> strict no-op
+                valid = jnp.sum(w_b) > 0
+                sel = lambda n, o: jax.tree.map(
+                    lambda a, b: jnp.where(valid, a, b), n, o
+                )
+                out = (
+                    sel(new_g_vars, g_vars),
+                    sel(new_d_vars, d_vars),
+                    sel(new_g_os, g_os),
+                    sel(new_d_os, d_os),
+                    {
+                        "g_loss_sum": sums["g_loss_sum"]
+                        + jnp.where(valid, g_loss, 0.0),
+                        "d_loss_sum": sums["d_loss_sum"]
+                        + jnp.where(valid, d_loss, 0.0),
+                        "batches": sums["batches"]
+                        + jnp.where(valid, 1.0, 0.0),
+                    },
+                )
+                return out, None
+
+            carry, _ = jax.lax.scan(
+                step_body,
+                (g_vars, d_vars, g_os, d_os, sums),
+                jnp.arange(steps_per_epoch),
+            )
+            return carry, None
+
+        sums0 = {
+            "g_loss_sum": jnp.asarray(0.0),
+            "d_loss_sum": jnp.asarray(0.0),
+            "batches": jnp.asarray(0.0),
+        }
+        g_os = g_opt.init(gen_vars["params"])
+        d_os = d_opt.init(disc_vars["params"])
+        ekeys = jax.vmap(lambda e: jax.random.fold_in(rng, e))(
+            jnp.arange(train_cfg.epochs)
+        )
+        (g_vars, d_vars, _, _, sums), _ = jax.lax.scan(
+            epoch_body, (gen_vars, disc_vars, g_os, d_os, sums0), ekeys
+        )
+        n_k = jnp.sum(mask_row)
+        return g_vars, d_vars, n_k, sums
+
+    return update
+
+
+# ---------------------------------------------------------------------------
+# Synthetic-set generation, logit extraction, distillation
+# ---------------------------------------------------------------------------
+
+
+def build_dataset_generator(gen: GanModel, size: int, batch_size: int):
+    """``generate(gen_vars, rng)`` -> (synth_x [S,H,W,C], labels [S]).
+
+    Balanced labels + batched eval-mode generation (reference
+    ``generate_fake_dataset``, ``fedgdkd/server.py:196-206``). ``size`` must
+    be a multiple of ``batch_size`` (static shapes under jit).
+    """
+    assert size % batch_size == 0, (size, batch_size)
+    n_batches = size // batch_size
+    labels = (
+        jnp.arange(size, dtype=jnp.int32) % max(gen.num_classes, 1)
+        if gen.conditional
+        else None
+    )
+
+    def generate(gen_vars, rng):
+        def body(_, i):
+            z = gen.sample_noise(jax.random.fold_in(rng, i), batch_size)
+            lb = (
+                jax.lax.dynamic_slice_in_dim(labels, i * batch_size, batch_size)
+                if labels is not None
+                else None
+            )
+            return None, gen.apply_eval(gen_vars, z, lb)
+
+        _, batches = jax.lax.scan(body, None, jnp.arange(n_batches))
+        synth = batches.reshape((size,) + batches.shape[2:])
+        return synth, (labels if labels is not None
+                       else jnp.zeros((size,), jnp.int32))
+
+    return generate
+
+
+def build_logit_extractor(disc: DiscHandle, size: int, batch_size: int):
+    """``logits(disc_vars, synth_x)`` -> [S, K], eval mode (reference
+    ``get_classifier_logits``, ``fedgdkd/model_trainer.py:115-136``)."""
+    assert size % batch_size == 0
+    n_batches = size // batch_size
+
+    def extract(disc_vars, synth_x):
+        def body(_, i):
+            xb = jax.lax.dynamic_slice_in_dim(
+                synth_x, i * batch_size, batch_size
+            )
+            return None, disc.apply_eval(disc_vars, xb)
+
+        _, out = jax.lax.scan(body, None, jnp.arange(n_batches))
+        return out.reshape((size, -1))
+
+    return extract
+
+
+def build_kd_update(
+    disc: DiscHandle,
+    train_cfg: TrainConfig,
+    gan_cfg: GanConfig,
+    size: int,
+    batch_size: int,
+):
+    """``kd(disc_vars, synth_x, labels, teacher_logits, rng)`` -> new vars.
+
+    The classifier-side distillation loop (reference
+    ``knowledge_distillation``, ``fedgdkd/model_trainer.py:138-177``):
+    ``kd_epochs`` passes of ``(1-kd_alpha)*CE + kd_alpha*SoftTarget(T)``.
+    """
+    assert size % batch_size == 0
+    n_batches = size // batch_size
+    opt = make_client_optimizer(train_cfg)
+
+    def loss_fn(params, static, xb, yb, tb, rng):
+        variables = {**static, "params": params}
+        logits, new_vars = disc.apply_train(variables, xb, rng)
+        kd_loss = KD.soft_target(logits, tb, gan_cfg.kd_temperature)
+        ce = jnp.mean(
+            optax.softmax_cross_entropy_with_integer_labels(logits, yb)
+        )
+        loss = (1 - gan_cfg.kd_alpha) * ce + gan_cfg.kd_alpha * kd_loss
+        return loss, (new_vars, kd_loss)
+
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+    def kd(disc_vars, synth_x, labels, teacher_logits, rng):
+        opt_state = opt.init(disc_vars["params"])
+
+        def epoch_body(carry, ekey):
+            variables, opt_state, losses = carry
+
+            def step_body(carry2, i):
+                variables, opt_state, losses = carry2
+                sl = lambda a: jax.lax.dynamic_slice_in_dim(
+                    a, i * batch_size, batch_size
+                )
+                params = variables["params"]
+                static = {k: v for k, v in variables.items() if k != "params"}
+                (loss, (new_vars, kd_l)), grads = grad_fn(
+                    params, static, sl(synth_x), sl(labels),
+                    sl(teacher_logits), jax.random.fold_in(ekey, i),
+                )
+                updates, new_os = opt.update(grads, opt_state, params)
+                new_vars = {
+                    **new_vars,
+                    "params": optax.apply_updates(params, updates),
+                }
+                losses = {
+                    "kd_loss_sum": losses["kd_loss_sum"] + kd_l,
+                    "dist_loss_sum": losses["dist_loss_sum"] + loss,
+                    "batches": losses["batches"] + 1.0,
+                }
+                return (new_vars, new_os, losses), None
+
+            carry, _ = jax.lax.scan(
+                step_body, (variables, opt_state, losses),
+                jnp.arange(n_batches),
+            )
+            return carry, None
+
+        losses0 = {
+            "kd_loss_sum": jnp.asarray(0.0),
+            "dist_loss_sum": jnp.asarray(0.0),
+            "batches": jnp.asarray(0.0),
+        }
+        ekeys = jax.vmap(lambda e: jax.random.fold_in(rng, e))(
+            jnp.arange(gan_cfg.kd_epochs)
+        )
+        (variables, _, losses), _ = jax.lax.scan(
+            epoch_body, (disc_vars, opt_state, losses0), ekeys
+        )
+        return variables, losses
+
+    return kd

@@ -1,0 +1,606 @@
+"""The fork's GAN-based FL family as compiled round programs.
+
+- :class:`FedGANSim` — federated ACGAN: shared generator + discriminator,
+  both FedAvg-aggregated each round (reference
+  ``fedml_api/standalone/fedgan/server.py:15-140``,
+  ``fedml_api/distributed/fedgan/FedGANAggregator.py:13``).
+- :class:`FedGDKDSim` — the fork's thesis algorithm: federated conditional
+  generator + per-client (stateful) classifiers; generator-only FedAvg;
+  server-synthesized distillation set; leave-one-out mean-teacher logit
+  distillation; drift correction for newly-joined clients (reference
+  ``fedml_api/standalone/fedgdkd/server.py:70-165``).
+- :class:`FedDTGSim` — distributed-GAN variant: shared G + D, per-client
+  classifiers trained alongside with gradient reversal; G/D FedAvg;
+  leave-one-out distillation on a fake dataset (reference
+  ``fedml_api/standalone/fedDTG/server.py:74-133``,
+  ``ac_gan_model_trainer.py:63-163``).
+
+TPU design: each round is one jitted program — GAN local updates are
+vmapped over the cohort, aggregation is a weighted tree-mean, the
+distillation set is generated on device, and per-client logits for the
+leave-one-out teacher are a single ``[C, S, K]`` tensor (the mean-teacher
+for client i is ``(sum - own) / (C-1)`` — no python loop over clients).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from fedml_tpu.algorithms import gan_core as G
+from fedml_tpu.algorithms.base import (
+    build_evaluator,
+    make_client_optimizer,
+    make_task,
+)
+from fedml_tpu.config import ExperimentConfig
+from fedml_tpu.core import random as R
+from fedml_tpu.core import tree as T
+from fedml_tpu.data.federated import FederatedArrays, FederatedData
+from fedml_tpu.models.base import FedModel
+from fedml_tpu.models.gan import GanModel
+
+Pytree = Any
+
+
+def _stack_gather(stack: Pytree, cohort: jax.Array) -> Pytree:
+    return jax.tree.map(lambda s: s[cohort], stack)
+
+
+def _stack_scatter(stack: Pytree, cohort: jax.Array, new: Pytree) -> Pytree:
+    return jax.tree.map(lambda s, n: s.at[cohort].set(n), stack, new)
+
+
+def _vmap_init(init_fn, root_key, num_clients):
+    keys = jax.vmap(lambda i: jax.random.fold_in(root_key, i))(
+        jnp.arange(num_clients)
+    )
+    return jax.vmap(init_fn)(keys)
+
+
+class FedGANState(NamedTuple):
+    gen_vars: Pytree
+    disc_vars: Pytree
+    round: jax.Array
+
+
+class FedGANSim:
+    """Federated ACGAN: every sampled client adversarially trains the shared
+    (G, D) on local data; server averages both weighted by n_k."""
+
+    def __init__(
+        self,
+        gen: GanModel,
+        disc: G.DiscHandle,
+        data: FederatedData,
+        cfg: ExperimentConfig,
+    ):
+        self.gen, self.disc, self.cfg = gen, disc, cfg
+        pad = cfg.data.batch_size
+        self.arrays: FederatedArrays = data.to_arrays(pad_multiple=pad)
+        max_n = self.arrays.max_client_samples
+        self.batch_size = min(cfg.data.batch_size, max_n)
+        self.input_shape = self.arrays.x.shape[1:]
+        self.local_update = G.build_gan_local_update(
+            gen, disc, cfg.train, cfg.gan, self.batch_size, max_n,
+            mode="acgan",
+        )
+        self.root_key = jax.random.key(cfg.seed)
+        self._round_fn = jax.jit(self._round, donate_argnums=(0,))
+
+    def init(self) -> FedGANState:
+        k = jax.random.fold_in(self.root_key, 0x7FFFFFFF)
+        kg, kd = jax.random.split(k)
+        return FedGANState(
+            gen_vars=self.gen.init(kg),
+            disc_vars=self.disc.init(kd, self.input_shape),
+            round=jnp.asarray(0, jnp.int32),
+        )
+
+    def _round(self, state: FedGANState, arrays: FederatedArrays):
+        cfg = self.cfg.fed
+        rkey = R.round_key(self.root_key, state.round)
+        cohort = R.sample_clients(
+            jax.random.fold_in(rkey, 0), arrays.num_clients,
+            cfg.clients_per_round,
+        )
+        ckeys = jax.vmap(lambda c: R.client_key(rkey, c))(cohort)
+        g_stack, d_stack, n_k, sums = jax.vmap(
+            self.local_update, in_axes=(None, None, 0, 0, None, None, 0)
+        )(
+            state.gen_vars, state.disc_vars, arrays.idx[cohort],
+            arrays.mask[cohort], arrays.x, arrays.y, ckeys,
+        )
+        new_gen = T.tree_weighted_mean(g_stack, n_k)
+        new_disc = T.tree_weighted_mean(d_stack, n_k)
+        metrics = {
+            "g_loss": jnp.sum(sums["g_loss_sum"])
+            / jnp.maximum(jnp.sum(sums["batches"]), 1.0),
+            "d_loss": jnp.sum(sums["d_loss_sum"])
+            / jnp.maximum(jnp.sum(sums["batches"]), 1.0),
+        }
+        return (
+            FedGANState(new_gen, new_disc, state.round + 1),
+            metrics,
+        )
+
+    def run_round(self, state: FedGANState):
+        return self._round_fn(state, self.arrays)
+
+    def sample_images(self, state: FedGANState, n: int, seed: int = 0):
+        """Eval-mode image grid (reference ``log_gan_images``,
+        ``fedgan/server.py``)."""
+        k = jax.random.key(seed)
+        z = self.gen.sample_noise(k, n)
+        labels = self.gen.balanced_labels(n) if self.gen.conditional else None
+        return self.gen.apply_eval(state.gen_vars, z, labels)
+
+
+class FedGDKDState(NamedTuple):
+    gen_vars: Pytree  # global generator (the knowledge vehicle)
+    cls_stack: Pytree  # [num_clients, ...] stateful per-client classifiers
+    prev_synth_x: jax.Array  # last round's distillation set
+    prev_synth_y: jax.Array
+    prev_teacher: jax.Array  # mean logits over last round's cohort [S, K]
+    prev_sampled: jax.Array  # [num_clients] bool — in last round's cohort
+    round: jax.Array
+
+
+class FedGDKDSim:
+    """FedGDKD (the fork's flagship): data-free co-distillation via a
+    federated conditional generator; discriminator = each client's own
+    classifier (``fedgdkd/server.py:70-165``).
+
+    Per round (all one XLA program):
+      1. sample cohort; drift-correct new joiners by KD against last
+         round's distillation set + mean teacher (``server.py:92-97``)
+      2. vmapped ssgan adversarial training (G synced from global;
+         classifier = client's own, persisted) (``model_trainer.py:23-113``)
+      3. FedAvg the GENERATOR only, weighted by n_k (``server.py:105-108``)
+      4. generate distillation set from averaged G (``server.py:116``)
+      5. per-client logits -> leave-one-out mean teacher -> KD
+         (``server.py:121-133``)
+    """
+
+    def __init__(
+        self,
+        gen: GanModel,
+        classifier: FedModel,
+        data: FederatedData,
+        cfg: ExperimentConfig,
+    ):
+        self.gen, self.cfg = gen, cfg
+        self.classifier = classifier
+        self.disc = G.DiscHandle.from_fed_model(classifier)
+        pad = cfg.data.batch_size
+        self.arrays: FederatedArrays = data.to_arrays(pad_multiple=pad)
+        max_n = self.arrays.max_client_samples
+        self.batch_size = min(cfg.data.batch_size, max_n)
+        self.input_shape = self.arrays.x.shape[1:]
+        gan = cfg.gan
+        self.synth_size = (
+            gan.distillation_size // self.batch_size
+        ) * self.batch_size or self.batch_size
+        self.local_update = G.build_gan_local_update(
+            gen, self.disc, cfg.train, gan, self.batch_size, max_n,
+            mode="ssgan",
+        )
+        self.generate = G.build_dataset_generator(
+            gen, self.synth_size, self.batch_size
+        )
+        self.extract = G.build_logit_extractor(
+            self.disc, self.synth_size, self.batch_size
+        )
+        self.kd_update = G.build_kd_update(
+            self.disc, cfg.train, gan, self.synth_size, self.batch_size
+        )
+        self.task = make_task(data.task)
+        self.evaluator = build_evaluator(classifier, self.task)
+        self.root_key = jax.random.key(cfg.seed)
+        self._round_fn = jax.jit(self._round, donate_argnums=(0,))
+
+    def init(self) -> FedGDKDState:
+        k = jax.random.fold_in(self.root_key, 0x7FFFFFFF)
+        kg, kc = jax.random.split(k)
+        n = self.arrays.num_clients
+        cls_stack = _vmap_init(self.classifier.init, kc, n)
+        num_classes = self.arrays.num_classes
+        return FedGDKDState(
+            gen_vars=self.gen.init(kg),
+            cls_stack=cls_stack,
+            prev_synth_x=jnp.zeros(
+                (self.synth_size,) + tuple(self.input_shape), jnp.float32
+            ),
+            prev_synth_y=jnp.zeros((self.synth_size,), jnp.int32),
+            prev_teacher=jnp.zeros((self.synth_size, num_classes)),
+            prev_sampled=jnp.zeros((n,), bool),
+            round=jnp.asarray(0, jnp.int32),
+        )
+
+    def _round(self, state: FedGDKDState, arrays: FederatedArrays):
+        cfg = self.cfg.fed
+        rkey = R.round_key(self.root_key, state.round)
+        cohort = R.sample_clients(
+            jax.random.fold_in(rkey, 0), arrays.num_clients,
+            cfg.clients_per_round,
+        )
+        ckeys = jax.vmap(lambda c: R.client_key(rkey, c))(cohort)
+        cls_vars = _stack_gather(state.cls_stack, cohort)
+
+        # 1. drift correction: KD for cohort members NOT sampled last round
+        #    (server.py:92-97; no-op in round 0)
+        is_new = jnp.logical_and(
+            state.round > 0, ~state.prev_sampled[cohort]
+        )
+
+        def do_correct(cls_vars):
+            corrected, _ = jax.vmap(
+                self.kd_update, in_axes=(0, None, None, None, 0)
+            )(
+                cls_vars, state.prev_synth_x, state.prev_synth_y,
+                state.prev_teacher,
+                jax.vmap(lambda k: jax.random.fold_in(k, 0xD1F7))(ckeys),
+            )
+            return jax.tree.map(
+                lambda new, old: jnp.where(
+                    is_new.reshape((-1,) + (1,) * (new.ndim - 1)), new, old
+                ),
+                corrected, cls_vars,
+            )
+
+        # skip the whole KD pass when the cohort has no new joiners (the
+        # steady-state/full-participation common case)
+        cls_vars = jax.lax.cond(
+            jnp.any(is_new), do_correct, lambda v: v, cls_vars
+        )
+
+        # 2. adversarial co-training (generator from global)
+        g_stack, cls_vars, n_k, sums = jax.vmap(
+            self.local_update, in_axes=(None, 0, 0, 0, None, None, 0)
+        )(
+            state.gen_vars, cls_vars, arrays.idx[cohort],
+            arrays.mask[cohort], arrays.x, arrays.y, ckeys,
+        )
+
+        # 3. generator-only FedAvg (server.py:105-108)
+        new_gen = T.tree_weighted_mean(g_stack, n_k)
+
+        # 4. distillation set from the averaged generator (server.py:116)
+        synth_x, synth_y = self.generate(
+            new_gen, jax.random.fold_in(rkey, 0x5EED)
+        )
+
+        # 5. leave-one-out mean-teacher KD (server.py:121-133)
+        logits = jax.vmap(self.extract, in_axes=(0, None))(
+            cls_vars, synth_x
+        )  # [C, S, K]
+        c = logits.shape[0]
+        loo_teacher = (jnp.sum(logits, 0)[None] - logits) / jnp.maximum(
+            c - 1, 1
+        )
+        cls_vars, kd_losses = jax.vmap(
+            self.kd_update, in_axes=(0, None, None, 0, 0)
+        )(
+            cls_vars, synth_x, synth_y, loo_teacher,
+            jax.vmap(lambda k: jax.random.fold_in(k, 0xAD))(ckeys),
+        )
+
+        new_stack = _stack_scatter(state.cls_stack, cohort, cls_vars)
+        new_sampled = (
+            jnp.zeros_like(state.prev_sampled).at[cohort].set(True)
+        )
+        metrics = {
+            "g_loss": jnp.sum(sums["g_loss_sum"])
+            / jnp.maximum(jnp.sum(sums["batches"]), 1.0),
+            "d_loss": jnp.sum(sums["d_loss_sum"])
+            / jnp.maximum(jnp.sum(sums["batches"]), 1.0),
+            "kd_loss": jnp.sum(kd_losses["kd_loss_sum"])
+            / jnp.maximum(jnp.sum(kd_losses["batches"]), 1.0),
+        }
+        new_state = FedGDKDState(
+            gen_vars=new_gen,
+            cls_stack=new_stack,
+            prev_synth_x=synth_x,
+            prev_synth_y=synth_y,
+            prev_teacher=jnp.mean(logits, 0),
+            prev_sampled=new_sampled,
+            round=state.round + 1,
+        )
+        return new_state, metrics
+
+    def run_round(self, state: FedGDKDState):
+        return self._round_fn(state, self.arrays)
+
+    def evaluate_clients(self, state: FedGDKDState) -> dict:
+        """Mean per-client accuracy on the global test set (reference
+        ``_local_test_on_all_clients``,
+        ``HeterogeneousModelBaseTrainerAPI.py:82-164``)."""
+        n = self.arrays.num_clients
+        accs, losses = [], []
+        for i in range(n):
+            cv = jax.tree.map(lambda s: s[i], state.cls_stack)
+            m = self.evaluator(cv, self.arrays.test_x, self.arrays.test_y)
+            accs.append(float(m["acc"]))
+            losses.append(float(m["loss"]))
+        return {
+            "test_acc": sum(accs) / n,
+            "test_loss": sum(losses) / n,
+            "per_client_acc": accs,
+        }
+
+    def run(self, metrics_sink=None) -> FedGDKDState:
+        state = self.init()
+        for r in range(self.cfg.fed.num_rounds):
+            state, m = self.run_round(state)
+            record = {"round": r, **{k: float(v) for k, v in m.items()}}
+            if (r + 1) % self.cfg.fed.eval_every == 0 or (
+                r == self.cfg.fed.num_rounds - 1
+            ):
+                ev = self.evaluate_clients(state)
+                record.update(
+                    {"test_acc": ev["test_acc"], "test_loss": ev["test_loss"]}
+                )
+            if metrics_sink is not None:
+                metrics_sink.log(record)
+        return state
+
+
+@jax.custom_vjp
+def reverse_grad(x):
+    """Gradient-reversal (FedDTG's ``register_hook(lambda g: -g)``,
+    ``fedDTG/ac_gan_model_trainer.py:108``)."""
+    return x
+
+
+def _rg_fwd(x):
+    return x, None
+
+
+def _rg_bwd(_, g):
+    return (jax.tree.map(jnp.negative, g),)
+
+
+reverse_grad.defvjp(_rg_fwd, _rg_bwd)
+
+
+class FedDTGState(NamedTuple):
+    gen_vars: Pytree
+    disc_vars: Pytree
+    cls_stack: Pytree
+    round: jax.Array
+
+
+class FedDTGSim:
+    """FedDTG: shared (G, D) + per-client classifiers; GAN steps use a
+    dedicated validity-only D with soft real label 0.9, the classifier
+    co-trains on real+fake, and G receives a REVERSED gradient through the
+    classifier (``fedDTG/ac_gan_model_trainer.py:63-163``). After G/D
+    FedAvg, classifiers distill leave-one-out on a generated fake set
+    (``fedDTG/server.py:108-133``)."""
+
+    REAL_LABEL = 0.9
+
+    def __init__(
+        self,
+        gen: GanModel,
+        disc: G.DiscHandle,
+        classifier: FedModel,
+        data: FederatedData,
+        cfg: ExperimentConfig,
+    ):
+        self.gen, self.disc, self.cfg = gen, disc, cfg
+        self.classifier = classifier
+        self.cls_handle = G.DiscHandle.from_fed_model(classifier)
+        pad = cfg.data.batch_size
+        self.arrays: FederatedArrays = data.to_arrays(pad_multiple=pad)
+        self.max_n = self.arrays.max_client_samples
+        self.batch_size = min(cfg.data.batch_size, self.max_n)
+        self.input_shape = self.arrays.x.shape[1:]
+        self.synth_size = (
+            cfg.gan.distillation_size // self.batch_size
+        ) * self.batch_size or self.batch_size
+        self.generate = G.build_dataset_generator(
+            gen, self.synth_size, self.batch_size
+        )
+        self.extract = G.build_logit_extractor(
+            self.cls_handle, self.synth_size, self.batch_size
+        )
+        self.kd_update = G.build_kd_update(
+            self.cls_handle, cfg.train, cfg.gan, self.synth_size,
+            self.batch_size,
+        )
+        self.task = make_task(data.task)
+        self.evaluator = build_evaluator(classifier, self.task)
+        self.root_key = jax.random.key(cfg.seed)
+        self.local_update = self._build_local_update()
+        self._round_fn = jax.jit(self._round, donate_argnums=(0,))
+
+    def _build_local_update(self):
+        gen, disc, cls = self.gen, self.disc, self.cls_handle
+        cfg_t, cfg_g = self.cfg.train, self.cfg.gan
+        batch_size, max_n = self.batch_size, self.max_n
+        steps_per_epoch = max_n // batch_size
+        g_opt = G.make_gen_optimizer(cfg_g)
+        d_opt = G.make_gen_optimizer(cfg_g)  # D follows gen optimizer here
+        c_opt = make_client_optimizer(cfg_t)
+
+        def g_loss_fn(g_params, g_static, d_vars, c_vars, z, gl, w, rng):
+            g_vars = {**g_static, "params": g_params}
+            fakes, new_g = gen.apply_train(g_vars, z, gl)
+            (_, val), _ = disc.apply_train(d_vars, fakes, rng, validity=True)
+            pred, _ = cls.apply_train(c_vars, fakes, rng)
+            pred = reverse_grad(pred)  # :108 gradient reversal
+            adv = G._bce_logits(val, jnp.full(val.shape[0], self.REAL_LABEL), w)
+            aux = G._ce(pred, gl, w)
+            return 0.5 * (adv + aux), (new_g, fakes)
+
+        def d_loss_fn(d_params, d_static, fakes, x_b, w, rng):
+            d_vars = {**d_static, "params": d_params}
+            r1, r2 = jax.random.split(rng)
+            (_, v_r), d1 = disc.apply_train(d_vars, x_b, r1, validity=True)
+            (_, v_f), d2 = disc.apply_train(d1, fakes, r2, validity=True)
+            loss = 0.5 * (
+                G._bce_logits(v_r, jnp.full(v_r.shape[0], self.REAL_LABEL), w)
+                + G._bce_logits(v_f, jnp.zeros(v_f.shape[0]), w)
+            )
+            return loss, d2
+
+        def c_loss_fn(c_params, c_static, fakes, gl, x_b, y_b, w, rng):
+            c_vars = {**c_static, "params": c_params}
+            r1, r2 = jax.random.split(rng)
+            p_real, c1 = cls.apply_train(c_vars, x_b, r1)
+            p_fake, c2 = cls.apply_train(c1, fakes, r2)
+            loss = 0.5 * (G._ce(p_real, y_b, w) + G._ce(p_fake, gl, w))
+            return loss, c2
+
+        g_grad = jax.value_and_grad(g_loss_fn, has_aux=True)
+        d_grad = jax.value_and_grad(d_loss_fn, has_aux=True)
+        c_grad = jax.value_and_grad(c_loss_fn, has_aux=True)
+
+        def update(gen_vars, disc_vars, cls_vars, idx_row, mask_row, x, y, rng):
+            def epoch_body(carry, ekey):
+                g_vars, d_vars, c_vars, g_os, d_os, c_os = carry
+                perm = jax.random.permutation(ekey, max_n)
+                order = jnp.argsort(1.0 - mask_row[perm], stable=True)
+                perm = perm[order]
+
+                def step_body(carry2, step):
+                    g_vars, d_vars, c_vars, g_os, d_os, c_os = carry2
+                    take = jax.lax.dynamic_slice_in_dim(
+                        perm, step * batch_size, batch_size
+                    )
+                    b_idx = idx_row[take]
+                    w_b = mask_row[take]
+                    x_b = jnp.take(x, b_idx, axis=0)
+                    y_b = jnp.take(y, b_idx, axis=0)
+                    skey = jax.random.fold_in(ekey, step)
+                    kz, kl, k1, k2, k3 = jax.random.split(skey, 5)
+                    z = gen.sample_noise(kz, batch_size)
+                    gl = gen.sample_labels(kl, batch_size)
+
+                    gp = g_vars["params"]
+                    gs = {k: v for k, v in g_vars.items() if k != "params"}
+                    (_, (new_g, fakes)), ggr = g_grad(
+                        gp, gs, d_vars, c_vars, z, gl, w_b, k1
+                    )
+                    gu, new_g_os = g_opt.update(ggr, g_os, gp)
+                    new_g = {**new_g, "params": optax.apply_updates(gp, gu)}
+
+                    fakes = jax.lax.stop_gradient(fakes)
+                    dp = d_vars["params"]
+                    ds = {k: v for k, v in d_vars.items() if k != "params"}
+                    (_, new_d), dgr = d_grad(dp, ds, fakes, x_b, w_b, k2)
+                    du, new_d_os = d_opt.update(dgr, d_os, dp)
+                    new_d = {**new_d, "params": optax.apply_updates(dp, du)}
+
+                    cp = c_vars["params"]
+                    cs = {k: v for k, v in c_vars.items() if k != "params"}
+                    (_, new_c), cgr = c_grad(
+                        cp, cs, fakes, gl, x_b, y_b, w_b, k3
+                    )
+                    cu, new_c_os = c_opt.update(cgr, c_os, cp)
+                    new_c = {**new_c, "params": optax.apply_updates(cp, cu)}
+
+                    valid = jnp.sum(w_b) > 0
+                    sel = lambda n, o: jax.tree.map(
+                        lambda a, b: jnp.where(valid, a, b), n, o
+                    )
+                    return (
+                        sel(new_g, g_vars), sel(new_d, d_vars),
+                        sel(new_c, c_vars), sel(new_g_os, g_os),
+                        sel(new_d_os, d_os), sel(new_c_os, c_os),
+                    ), None
+
+                carry, _ = jax.lax.scan(
+                    step_body, (g_vars, d_vars, c_vars, g_os, d_os, c_os),
+                    jnp.arange(steps_per_epoch),
+                )
+                return carry, None
+
+            g_os = g_opt.init(gen_vars["params"])
+            d_os = d_opt.init(disc_vars["params"])
+            c_os = c_opt.init(cls_vars["params"])
+            ekeys = jax.vmap(lambda e: jax.random.fold_in(rng, e))(
+                jnp.arange(cfg_t.epochs)
+            )
+            (g_vars, d_vars, c_vars, _, _, _), _ = jax.lax.scan(
+                epoch_body,
+                (gen_vars, disc_vars, cls_vars, g_os, d_os, c_os),
+                ekeys,
+            )
+            return g_vars, d_vars, c_vars, jnp.sum(mask_row)
+
+        return update
+
+    def init(self) -> FedDTGState:
+        k = jax.random.fold_in(self.root_key, 0x7FFFFFFF)
+        kg, kd, kc = jax.random.split(k, 3)
+        return FedDTGState(
+            gen_vars=self.gen.init(kg),
+            disc_vars=self.disc.init(kd, self.input_shape),
+            cls_stack=_vmap_init(
+                self.classifier.init, kc, self.arrays.num_clients
+            ),
+            round=jnp.asarray(0, jnp.int32),
+        )
+
+    def _round(self, state: FedDTGState, arrays: FederatedArrays):
+        cfg = self.cfg.fed
+        rkey = R.round_key(self.root_key, state.round)
+        cohort = R.sample_clients(
+            jax.random.fold_in(rkey, 0), arrays.num_clients,
+            cfg.clients_per_round,
+        )
+        ckeys = jax.vmap(lambda c: R.client_key(rkey, c))(cohort)
+        cls_vars = _stack_gather(state.cls_stack, cohort)
+
+        g_stack, d_stack, cls_vars, n_k = jax.vmap(
+            self.local_update, in_axes=(None, None, 0, 0, 0, None, None, 0)
+        )(
+            state.gen_vars, state.disc_vars, cls_vars, arrays.idx[cohort],
+            arrays.mask[cohort], arrays.x, arrays.y, ckeys,
+        )
+        new_gen = T.tree_weighted_mean(g_stack, n_k)
+        new_disc = T.tree_weighted_mean(d_stack, n_k)
+
+        synth_x, synth_y = self.generate(
+            new_gen, jax.random.fold_in(rkey, 0x5EED)
+        )
+        logits = jax.vmap(self.extract, in_axes=(0, None))(cls_vars, synth_x)
+        c = logits.shape[0]
+        loo = (jnp.sum(logits, 0)[None] - logits) / jnp.maximum(c - 1, 1)
+        cls_vars, kd_losses = jax.vmap(
+            self.kd_update, in_axes=(0, None, None, 0, 0)
+        )(
+            cls_vars, synth_x, synth_y, loo,
+            # distinct fold so the KD key stream cannot collide with the
+            # adversarial phase's (which already consumed ckeys)
+            jax.vmap(lambda k: jax.random.fold_in(k, 0xAD))(ckeys),
+        )
+
+        new_state = FedDTGState(
+            gen_vars=new_gen,
+            disc_vars=new_disc,
+            cls_stack=_stack_scatter(state.cls_stack, cohort, cls_vars),
+            round=state.round + 1,
+        )
+        metrics = {
+            "kd_loss": jnp.sum(kd_losses["kd_loss_sum"])
+            / jnp.maximum(jnp.sum(kd_losses["batches"]), 1.0),
+        }
+        return new_state, metrics
+
+    def run_round(self, state: FedDTGState):
+        return self._round_fn(state, self.arrays)
+
+    def evaluate_clients(self, state: FedDTGState) -> dict:
+        n = self.arrays.num_clients
+        accs = []
+        for i in range(n):
+            cv = jax.tree.map(lambda s: s[i], state.cls_stack)
+            m = self.evaluator(cv, self.arrays.test_x, self.arrays.test_y)
+            accs.append(float(m["acc"]))
+        return {"test_acc": sum(accs) / n, "per_client_acc": accs}

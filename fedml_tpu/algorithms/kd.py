@@ -1,0 +1,40 @@
+"""Knowledge-distillation losses.
+
+Pure-function equivalents of the reference's top-level
+``knowledge_distillation`` package: ``SoftTarget`` (Hinton KL with T^2
+scaling, ``knowledge_distillation/soft_target.py:5-19``) and ``Logits``
+(MSE on raw logits, ``knowledge_distillation/logits.py:10-17``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def soft_target(student_logits, teacher_logits, T: float = 4.0, w=None):
+    """T^2-scaled KL(softmax(t/T) || softmax(s/T)), batch-mean.
+
+    Matches ``F.kl_div(log_softmax(s/T), softmax(t/T),
+    reduction='batchmean') * T * T`` (``soft_target.py:15-19``):
+    batchmean divides by the batch size only, summing over classes.
+    ``w`` optionally masks padded rows (the masked mean divides by the
+    number of REAL rows, exactly the torch batchmean over the real batch).
+    """
+    log_p_s = jax.nn.log_softmax(student_logits / T, axis=-1)
+    p_t = jax.nn.softmax(teacher_logits / T, axis=-1)
+    log_p_t = jax.nn.log_softmax(teacher_logits / T, axis=-1)
+    per_row = jnp.sum(p_t * (log_p_t - log_p_s), axis=-1)
+    if w is None:
+        return jnp.mean(per_row) * T * T
+    return jnp.sum(per_row * w) / jnp.maximum(jnp.sum(w), 1.0) * T * T
+
+
+def logits_mse(student_logits, teacher_logits, w=None):
+    """Plain MSE on logits (``logits.py:14-17``)."""
+    per_row = jnp.mean(
+        jnp.square(student_logits - teacher_logits), axis=-1
+    )
+    if w is None:
+        return jnp.mean(per_row)
+    return jnp.sum(per_row * w) / jnp.maximum(jnp.sum(w), 1.0)

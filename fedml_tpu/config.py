@@ -91,6 +91,34 @@ class FedConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class GanConfig:
+    """GAN + knowledge-distillation knobs for the fork's GAN/KD algorithm
+    family. Defaults follow the reference experiment entry
+    (``fedml_experiments/standalone/fedgdkd/main_fedgdkd.py:21-52``:
+    kd_alpha 0.8, gen_lr 1e-3 adam, kd_epochs 5, distillation set 10000)
+    except ``distillation_size`` which defaults smaller — it is a static
+    shape under jit and 10k is wasteful for small experiments.
+    """
+
+    nz: int = 100  # latent vector size
+    ngf: int = 64  # generator feature multiplier
+    gen_optimizer: str = "adam"
+    gen_lr: float = 1e-3
+    kd_alpha: float = 0.8  # weight of the KD term vs CE
+    kd_epochs: int = 5
+    kd_temperature: float = 4.0  # SoftTarget T (fedgdkd/model_trainer.py:152)
+    distillation_size: int = 1024
+    # FedSSGAN pseudo-label confidence threshold (federated_sgan
+    # model_trainer realism threshold)
+    pseudo_label_threshold: float = 0.9
+    # FedMD/FD+FAug public-set + digest knobs
+    public_size: int = 1024
+    digest_epochs: int = 1
+    # FD per-label logit regularizer weight (Jeong et al. FD)
+    fd_beta: float = 0.1
+
+
+@dataclasses.dataclass(frozen=True)
 class MeshConfig:
     """Device-mesh layout for the scale-out runtime.
 
@@ -112,6 +140,7 @@ class ExperimentConfig:
     train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
     fed: FedConfig = dataclasses.field(default_factory=FedConfig)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    gan: GanConfig = dataclasses.field(default_factory=GanConfig)
     seed: int = 0
     run_name: str = "run"
     out_dir: str = "./runs"
@@ -142,6 +171,7 @@ class ExperimentConfig:
             train=build(TrainConfig, d.get("train")),
             fed=build(FedConfig, d.get("fed")),
             mesh=build(MeshConfig, d.get("mesh")),
+            gan=build(GanConfig, d.get("gan")),
             seed=d.get("seed", 0),
             run_name=d.get("run_name", "run"),
             out_dir=d.get("out_dir", "./runs"),

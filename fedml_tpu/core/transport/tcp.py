@@ -37,7 +37,11 @@ class TcpTransport(BaseTransport):
         self.ip_config = ip_config
         self._server: socket.socket | None = None
         self._conns: dict[int, socket.socket] = {}
+        # one lock per peer rank so a slow/blocked connect or send to one
+        # peer never serializes traffic to the others; a global lock guards
+        # only the dict itself
         self._lock = threading.Lock()
+        self._rank_locks: dict[int, threading.Lock] = {}
         self._threads: list[threading.Thread] = []
 
     # -- receive side ------------------------------------------------------
@@ -80,20 +84,41 @@ class TcpTransport(BaseTransport):
                 self.deliver(Message.decode(data))
 
     # -- send side ---------------------------------------------------------
-    def _conn_to(self, rank: int) -> socket.socket:
+    def _rank_lock(self, rank: int) -> threading.Lock:
         with self._lock:
-            sock = self._conns.get(rank)
-            if sock is None:
-                host, port = self.ip_config[rank]
-                sock = socket.create_connection((host, port), timeout=30)
-                self._conns[rank] = sock
-            return sock
+            lock = self._rank_locks.get(rank)
+            if lock is None:
+                lock = self._rank_locks[rank] = threading.Lock()
+            return lock
 
     def send_message(self, msg: Message) -> None:
         data = msg.encode()
-        sock = self._conn_to(msg.receiver)
-        with self._lock:
-            sock.sendall(_HDR.pack(len(data)) + data)
+        rank = msg.receiver
+        frame = _HDR.pack(len(data)) + data
+        with self._rank_lock(rank):
+            with self._lock:
+                sock = self._conns.get(rank)
+            if sock is None:
+                host, port = self.ip_config[rank]
+                sock = socket.create_connection((host, port), timeout=30)
+                with self._lock:
+                    self._conns[rank] = sock
+            try:
+                sock.sendall(frame)
+            except OSError:
+                # evict the dead socket and retry once on a fresh connection
+                # (peer restarted / broken pipe)
+                with self._lock:
+                    self._conns.pop(rank, None)
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                host, port = self.ip_config[rank]
+                sock = socket.create_connection((host, port), timeout=30)
+                with self._lock:
+                    self._conns[rank] = sock
+                sock.sendall(frame)
 
     def stop(self) -> None:
         super().stop()

@@ -1,0 +1,234 @@
+"""GAN model zoo: conditional/unconditional image generators and the ACGAN
+discriminator family.
+
+TPU-native re-design of the reference GAN models
+(``fedml_api/model/cv/generator.py:29-145``, ``fedml_api/model/cv/mnist_gan.py:6-55``,
+``fedml_api/model/cv/cnn_custom.py:8-60`` — the parameterised CNN whose
+``discriminator=True`` call path returns (class_logits, validity)).
+
+Design notes (TPU-first):
+- NHWC layout throughout (XLA's preferred conv layout on TPU).
+- ``ConvTranspose`` pyramids sized so every intermediate is a multiple of 8
+  in the spatial dims where possible; channel counts are multiples of 64 by
+  default (``ngf``), which tiles cleanly onto the MXU.
+- The generator mirrors the reference's shape recipe: a label embedding is
+  multiplied elementwise with the noise vector, projected by a dense layer
+  to ``first_filters * init_size**2``, then upsampled by stride-2
+  transposed convs with BatchNorm+ReLU, ending in tanh
+  (``generator.py:72-125``).
+- ``img_size`` need not be a power of two: we pick the largest number of
+  doublings such that ``init_size = img_size >> n_ups`` stays >= 4 (so
+  MNIST's 28 -> init 7, two upsamplings; CIFAR's 32 -> init 4, three).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def _plan_upsampling(img_size: int, min_init: int = 4) -> tuple[int, int]:
+    """Number of stride-2 upsamplings and the starting spatial size."""
+    n_ups = 0
+    size = img_size
+    while size % 2 == 0 and size // 2 >= min_init:
+        size //= 2
+        n_ups += 1
+    if n_ups == 0:
+        raise ValueError(f"img_size {img_size} too small for a conv pyramid")
+    return n_ups, size
+
+
+class ConditionalImageGenerator(nn.Module):
+    """Label-conditioned DCGAN-style generator
+    (reference ``ConditionalImageGenerator``, ``generator.py:72-125``).
+
+    ``__call__(z, labels)`` with ``z`` [B, nz] float and ``labels`` [B] int
+    returns images [B, H, W, C] in (-1, 1) (tanh).
+    """
+
+    num_classes: int
+    img_size: int = 32
+    channels: int = 3
+    nz: int = 100
+    ngf: int = 64
+
+    @nn.compact
+    def __call__(self, z, labels, train: bool = False):
+        n_ups, init_size = _plan_upsampling(self.img_size)
+        # final ConvTranspose is one of the upsamplings; inner blocks = rest
+        n_blocks = n_ups - 1
+        first_filters = self.ngf * (2 ** n_blocks)
+
+        emb = nn.Embed(self.num_classes, self.nz, name="label_emb")(labels)
+        h = nn.Dense(first_filters * init_size * init_size, name="l1")(z * emb)
+        h = h.reshape((-1, init_size, init_size, first_filters))
+        for i in range(n_blocks):
+            feats = self.ngf * (2 ** (n_blocks - 1 - i))
+            h = nn.ConvTranspose(
+                feats, (4, 4), strides=(2, 2), padding="SAME", use_bias=False
+            )(h)
+            h = nn.BatchNorm(use_running_average=not train)(h)
+            h = nn.relu(h)
+        h = nn.ConvTranspose(
+            self.channels, (4, 4), strides=(2, 2), padding="SAME",
+            use_bias=False,
+        )(h)
+        return jnp.tanh(h)
+
+
+class ImageGenerator(nn.Module):
+    """Unconditional DCGAN generator (reference ``ImageGenerator``,
+    ``generator.py:29-69``)."""
+
+    img_size: int = 32
+    channels: int = 3
+    nz: int = 100
+    ngf: int = 64
+
+    @nn.compact
+    def __call__(self, z, train: bool = False):
+        n_ups, init_size = _plan_upsampling(self.img_size)
+        n_blocks = n_ups - 1
+        first_filters = self.ngf * (2 ** n_blocks)
+        h = nn.Dense(first_filters * init_size * init_size)(z)
+        h = h.reshape((-1, init_size, init_size, first_filters))
+        for i in range(n_blocks):
+            feats = self.ngf * (2 ** (n_blocks - 1 - i))
+            h = nn.ConvTranspose(
+                feats, (4, 4), strides=(2, 2), padding="SAME", use_bias=False
+            )(h)
+            h = nn.BatchNorm(use_running_average=not train)(h)
+            h = nn.relu(h)
+        h = nn.ConvTranspose(
+            self.channels, (4, 4), strides=(2, 2), padding="SAME",
+            use_bias=False,
+        )(h)
+        return jnp.tanh(h)
+
+
+class ACGANDiscriminator(nn.Module):
+    """Conv classifier with an auxiliary validity head — the shape of the
+    fork's client models (``cnn_custom.py:8-60``): a strided-conv trunk, a
+    class-logits head, and a ``discriminator`` head producing one
+    real/fake logit. We return the validity as a LOGIT (the reference
+    applies an in-module Sigmoid and BCELoss; sigmoid+BCE == BCE-with-logits).
+
+    ``__call__(x, train)`` -> class_logits [B, K]
+    ``__call__(x, train, discriminator=True)`` -> (class_logits, validity [B, 1])
+    """
+
+    num_classes: int
+    features: Sequence[int] = (32, 64, 128)
+    dropout: float = 0.25
+
+    @nn.compact
+    def __call__(self, x, train: bool = False, discriminator: bool = False):
+        h = x
+        for f in self.features:
+            h = nn.Conv(f, (3, 3), strides=(2, 2), padding="SAME",
+                        use_bias=False)(h)
+            h = nn.leaky_relu(h, 0.2)
+            h = nn.Dropout(self.dropout, deterministic=not train)(h)
+            h = nn.BatchNorm(use_running_average=not train)(h)
+        h = h.reshape((h.shape[0], -1))
+        trunk = h
+        cls = nn.Dense(128, name="cls_hidden")(trunk)
+        cls = nn.Dense(self.num_classes, name="cls_out")(cls)
+        if not discriminator:
+            return cls
+        val = nn.Dense(128, name="disc_hidden")(trunk)
+        val = nn.Dense(1, name="disc_out")(val)
+        return cls, val
+
+
+@dataclasses.dataclass(frozen=True)
+class GanModel:
+    """Functional handle on a generator module (conditional or not), the GAN
+    analog of :class:`fedml_tpu.models.base.FedModel`."""
+
+    module: nn.Module
+    nz: int
+    num_classes: int
+    conditional: bool = True
+
+    def init(self, rng: jax.Array) -> Any:
+        z = jnp.zeros((1, self.nz), jnp.float32)
+        if self.conditional:
+            return self.module.init(
+                {"params": rng}, z, jnp.zeros((1,), jnp.int32), train=False
+            )
+        return self.module.init({"params": rng}, z, train=False)
+
+    def apply_train(self, variables, z, labels=None):
+        args = (z, labels) if self.conditional else (z,)
+        imgs, mutated = self.module.apply(
+            variables, *args, train=True, mutable=["batch_stats"]
+        )
+        return imgs, {**variables, **mutated}
+
+    def apply_eval(self, variables, z, labels=None):
+        args = (z, labels) if self.conditional else (z,)
+        return self.module.apply(variables, *args, train=False)
+
+    def sample_noise(self, rng: jax.Array, n: int) -> jax.Array:
+        """Gaussian latent (reference ``generate_noise_vector``,
+        ``generator.py:120-121``)."""
+        return jax.random.normal(rng, (n, self.nz))
+
+    def sample_labels(self, rng: jax.Array, n: int) -> jax.Array:
+        return jax.random.randint(rng, (n,), 0, self.num_classes)
+
+    def balanced_labels(self, n: int) -> jax.Array:
+        """Near-uniform label vector (reference ``generate_balanced_labels``,
+        ``generator.py:129-145``): class c appears ceil/floor(n/K) times."""
+        return jnp.arange(n, dtype=jnp.int32) % self.num_classes
+
+
+def create_conditional_generator(
+    num_classes: int,
+    img_size: int = 32,
+    channels: int = 3,
+    nz: int = 100,
+    ngf: int = 64,
+) -> GanModel:
+    return GanModel(
+        module=ConditionalImageGenerator(
+            num_classes=num_classes, img_size=img_size, channels=channels,
+            nz=nz, ngf=ngf,
+        ),
+        nz=nz,
+        num_classes=num_classes,
+        conditional=True,
+    )
+
+
+def generator_from_config(
+    gan_cfg, num_classes: int, img_size: int, channels: int,
+    conditional: bool = True,
+) -> GanModel:
+    """Build a generator from :class:`fedml_tpu.config.GanConfig` so the
+    ``nz``/``ngf`` knobs in experiment configs are authoritative (reference
+    ``--nz``/``--ngf`` args, ``main_fedgdkd.py:29-36``)."""
+    if conditional:
+        return create_conditional_generator(
+            num_classes, img_size, channels, nz=gan_cfg.nz, ngf=gan_cfg.ngf
+        )
+    return create_generator(img_size, channels, nz=gan_cfg.nz, ngf=gan_cfg.ngf)
+
+
+def create_generator(
+    img_size: int = 32, channels: int = 3, nz: int = 100, ngf: int = 64
+) -> GanModel:
+    return GanModel(
+        module=ImageGenerator(
+            img_size=img_size, channels=channels, nz=nz, ngf=ngf
+        ),
+        nz=nz,
+        num_classes=0,
+        conditional=False,
+    )
