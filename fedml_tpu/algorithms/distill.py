@@ -1,0 +1,668 @@
+"""Distillation-based FL without generators: FedMD, FD (+FAug), FedArjun.
+
+Reference semantics (all compiled here into single-program rounds with the
+cohort vmapped):
+
+- **FedMD** (``fedml_api/standalone/fedmd/``): a public dataset assembled
+  from client data shares (``FedMD_api.py:31-47``, ``client.py:27-33``);
+  per round each client computes logits on the public set, the server
+  averages them into a consensus, and each client runs *digest* (CE +
+  ``kd_lambda`` * logits-MSE toward the consensus on public data,
+  ``model_trainer.py:50-77``) then *revisit* (CE on private data). Clients
+  pre-train on public then private data (``model_trainer.py:21-48``).
+- **FD + FAug** (``fedml_api/standalone/fd_faug/``): federated distillation
+  via per-LABEL average logits. During local training each client
+  accumulates label-wise mean logits; the server exchanges leave-one-out
+  global label averages (``FD_FAug_api.py:99-138``); the client regularizes
+  with ``(1-kd_gamma)*CE + kd_gamma*CE(output, softmax(teacher[label]))``
+  (``model_trainer.py:46-68``). (FAug's shared-GAN augmentation is a TODO
+  in the reference — ``FD_FAug_api.py:100-101`` — the GAN path here is
+  available separately via :mod:`fedml_tpu.algorithms.gan_family`.)
+- **FedArjun** (``fedml_api/standalone/federated_arjun/``): each client
+  holds a FedAvg-shared *adapter* model + a private local model; per round
+  1) KD adapter->local, 2) train local, 3) KD local->adapter
+  (``model_trainer.py:38-76``); only adapters are aggregated. KD loss is
+  ``(1-kd_lambda)*CE + kd_lambda*SoftTarget(T=4)``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from fedml_tpu.algorithms import kd as KD
+from fedml_tpu.algorithms.base import (
+    build_evaluator,
+    build_local_update,
+    make_client_optimizer,
+    make_task,
+)
+from fedml_tpu.config import ExperimentConfig
+from fedml_tpu.core import random as R
+from fedml_tpu.core import tree as T
+from fedml_tpu.data.federated import FederatedArrays, FederatedData
+from fedml_tpu.models.base import FedModel
+
+Pytree = Any
+
+
+from fedml_tpu.algorithms.stack_utils import (
+    evaluate_stack as _evaluate_stack,
+    stack_gather as _gather,
+    stack_scatter as _scatter,
+    vmap_init as _vmap_init,
+)
+
+
+def build_public_set(
+    data: FederatedData, public_size: int, batch_size: int, seed: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """Assemble the FedMD public set from equal client shares (reference
+    ``share_data``, ``fedmd/client.py:27-33``: each client contributes a
+    random ``share_percentage`` of its local data)."""
+    rng = np.random.default_rng(seed)
+    n_clients = data.num_clients
+    public_size = max(
+        batch_size, (public_size // batch_size) * batch_size
+    )
+    per_client = -(-public_size // n_clients)  # ceil
+    picks = []
+    for i in range(n_clients):
+        idx = data.train_idx_map[i]
+        take = min(per_client, len(idx))
+        picks.append(rng.choice(idx, take, replace=False))
+    picked = np.concatenate(picks)
+    if len(picked) < public_size:  # top up with yet-unpicked global samples
+        pool = np.setdiff1d(np.arange(len(data.x_train)), picked)
+        extra = rng.choice(
+            pool, min(len(pool), public_size - len(picked)), replace=False
+        )
+        picked = np.concatenate([picked, extra])
+    if len(picked) < public_size:  # degenerate tiny datasets: repeat
+        reps = rng.choice(picked, public_size - len(picked), replace=True)
+        picked = np.concatenate([picked, reps])
+    picked = picked[:public_size]
+    return data.x_train[picked], data.y_train[picked]
+
+
+def _build_supervised_kd_loop(
+    model: FedModel, opt, size: int, batch_size: int, mode: str,
+    kd_weight: float,
+):
+    """Scan-based epochs over a fixed (public) set with an optional
+    teacher-logits alignment term. ``mode``: "mse" (FedMD digest) or
+    "none" (plain CE)."""
+    assert size % batch_size == 0
+    n_batches = size // batch_size
+
+    def loss_fn(params, static, xb, yb, tb, rng):
+        variables = {**static, "params": params}
+        logits, new_vars = model.apply_train(variables, xb, rng)
+        ce = jnp.mean(
+            optax.softmax_cross_entropy_with_integer_labels(logits, yb)
+        )
+        if mode == "mse":
+            # digest: CE + kd_lambda * MSE(out, consensus)
+            # (fedmd/model_trainer.py:67-74,119-124)
+            loss = ce + kd_weight * KD.logits_mse(logits, tb)
+        else:
+            loss = ce
+        return loss, new_vars
+
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+    def run(variables, x, y, teacher, rng, epochs: int):
+        opt_state = opt.init(variables["params"])
+
+        def epoch_body(carry, ekey):
+            variables, opt_state = carry
+
+            def step(carry2, i):
+                variables, opt_state = carry2
+                sl = lambda a: jax.lax.dynamic_slice_in_dim(
+                    a, i * batch_size, batch_size
+                )
+                params = variables["params"]
+                static = {k: v for k, v in variables.items() if k != "params"}
+                (_, new_vars), grads = grad_fn(
+                    params, static, sl(x), sl(y),
+                    sl(teacher) if teacher is not None else None,
+                    jax.random.fold_in(ekey, i),
+                )
+                updates, new_os = opt.update(grads, opt_state, params)
+                new_vars = {
+                    **new_vars,
+                    "params": optax.apply_updates(params, updates),
+                }
+                return (new_vars, new_os), None
+
+            carry2, _ = jax.lax.scan(
+                step, (variables, opt_state), jnp.arange(n_batches)
+            )
+            return carry2, None
+
+        ekeys = jax.vmap(lambda e: jax.random.fold_in(rng, e))(
+            jnp.arange(epochs)
+        )
+        (variables, _), _ = jax.lax.scan(
+            epoch_body, (variables, opt_state), ekeys
+        )
+        return variables
+
+    return run
+
+
+class FedMDState(NamedTuple):
+    model_stack: Pytree  # [N, ...] per-client (stateful) models
+    round: jax.Array
+
+
+class FedMDSim:
+    """FedMD: logit-consensus distillation on a shared public dataset."""
+
+    def __init__(
+        self, model: FedModel, data: FederatedData, cfg: ExperimentConfig
+    ):
+        self.model, self.cfg = model, cfg
+        self.task = make_task(data.task)
+        pad = cfg.data.batch_size
+        self.arrays: FederatedArrays = data.to_arrays(pad_multiple=pad)
+        max_n = self.arrays.max_client_samples
+        self.batch_size = min(cfg.data.batch_size, max_n)
+        px, py = build_public_set(
+            data, cfg.gan.public_size, self.batch_size, cfg.data.seed
+        )
+        self.pub_x = jnp.asarray(px, jnp.float32)
+        self.pub_y = jnp.asarray(py)
+        self.pub_size = self.pub_x.shape[0]
+        opt = make_client_optimizer(cfg.train)
+        self.digest = _build_supervised_kd_loop(
+            model, opt, self.pub_size, self.batch_size, "mse",
+            cfg.gan.kd_lambda,
+        )
+        self.pub_train = _build_supervised_kd_loop(
+            model, opt, self.pub_size, self.batch_size, "none", 0.0
+        )
+        self.local_update = build_local_update(
+            model, self.task, cfg.train, self.batch_size, max_n
+        )
+        # private pretraining honors its own epoch count
+        # (fedmd/model_trainer.py:46-48 pretrain_epochs_private)
+        import dataclasses as _dc
+
+        self.pretrain_local = build_local_update(
+            model, self.task,
+            _dc.replace(cfg.train, epochs=max(1, cfg.gan.pretrain_epochs_private)),
+            self.batch_size, max_n,
+        )
+        n_b = self.pub_size // self.batch_size
+
+        def extract(variables):
+            def body(_, i):
+                xb = jax.lax.dynamic_slice_in_dim(
+                    self.pub_x, i * self.batch_size, self.batch_size
+                )
+                return None, model.apply_eval(variables, xb)
+
+            _, out = jax.lax.scan(body, None, jnp.arange(n_b))
+            return out.reshape((self.pub_size, -1))
+
+        self.extract = extract
+        self.evaluator = build_evaluator(model, self.task)
+        self.root_key = jax.random.key(cfg.seed)
+        self._round_fn = jax.jit(self._round, donate_argnums=(0,))
+        self._pretrain_fn = jax.jit(self._pretrain)
+
+    # -- phases -------------------------------------------------------------
+    def _pretrain(self, stack, arrays: FederatedArrays):
+        """Transfer learning: public then private (``model_trainer.py:21-48``)."""
+        n = arrays.num_clients
+        keys = jax.vmap(
+            lambda i: jax.random.fold_in(self.root_key, 0xBEEF + i)
+        )(jnp.arange(n))
+        g = self.cfg.gan
+        stack = jax.vmap(
+            lambda v, k: self.pub_train(
+                v, self.pub_x, self.pub_y, None, k, g.pretrain_epochs_public
+            )
+        )(stack, keys)
+        stack, _, _ = jax.vmap(
+            self.pretrain_local, in_axes=(0, 0, 0, None, None, 0)
+        )(stack, arrays.idx, arrays.mask, arrays.x, arrays.y, keys)
+        return stack
+
+    def _round(self, state: FedMDState, arrays: FederatedArrays):
+        cfg = self.cfg.fed
+        g = self.cfg.gan
+        rkey = R.round_key(self.root_key, state.round)
+        cohort = R.sample_clients(
+            jax.random.fold_in(rkey, 0), arrays.num_clients,
+            cfg.clients_per_round,
+        )
+        ckeys = jax.vmap(lambda c: R.client_key(rkey, c))(cohort)
+        mvars = _gather(state.model_stack, cohort)
+
+        # 1. communicate: logits on public set; 2. aggregate: mean consensus
+        #    (FedMD_api.py:82-96)
+        logits = jax.vmap(self.extract)(mvars)  # [C, P, K]
+        consensus = jnp.mean(logits, axis=0)  # [P, K]
+
+        # 3. digest (toward consensus) + revisit (private CE)
+        #    (FedMD_api.py:98-103, model_trainer.py:50-77)
+        mvars = jax.vmap(
+            lambda v, k: self.digest(
+                v, self.pub_x, self.pub_y, consensus,
+                jax.random.fold_in(k, 1), g.digest_epochs,
+            )
+        )(mvars, ckeys)
+        for i in range(max(1, g.revisit_epochs)):
+            mvars, _, msums = jax.vmap(
+                self.local_update, in_axes=(0, 0, 0, None, None, 0)
+            )(
+                mvars, arrays.idx[cohort], arrays.mask[cohort],
+                arrays.x, arrays.y,
+                jax.vmap(lambda k: jax.random.fold_in(k, 2 + i))(ckeys),
+            )
+
+        new_stack = _scatter(state.model_stack, cohort, mvars)
+        reduced = jax.tree.map(jnp.sum, msums)
+        return (
+            FedMDState(new_stack, state.round + 1),
+            {
+                "train_loss": reduced["loss_sum"]
+                / jnp.maximum(reduced["w_sum"], 1.0)
+            },
+        )
+
+    # -- public API ---------------------------------------------------------
+    def init(self, pretrain: bool = True) -> FedMDState:
+        stack = _vmap_init(
+            self.model.init,
+            jax.random.fold_in(self.root_key, 0x7FFFFFFF),
+            self.arrays.num_clients,
+        )
+        if pretrain:
+            stack = self._pretrain_fn(stack, self.arrays)
+        return FedMDState(stack, jnp.asarray(0, jnp.int32))
+
+    def run_round(self, state: FedMDState):
+        return self._round_fn(state, self.arrays)
+
+    def evaluate_clients(self, state: FedMDState) -> dict:
+        return _evaluate_stack(
+            self.evaluator, state.model_stack, self.arrays.test_x,
+            self.arrays.test_y, self.arrays.num_clients,
+        )
+
+
+class FDState(NamedTuple):
+    model_stack: Pytree  # [N, ...]
+    teacher: jax.Array  # [N, K, K] per-client per-label teacher logits
+    has_teacher: jax.Array  # [N, K] bool — teacher available PER LABEL
+    round: jax.Array
+
+
+class FDSim:
+    """FD (federated distillation via label-averaged logits), the FD half of
+    FD+FAug. One round = local training with the soft per-label teacher +
+    leave-one-out label-logit exchange."""
+
+    def __init__(
+        self, model: FedModel, data: FederatedData, cfg: ExperimentConfig
+    ):
+        self.model, self.cfg = model, cfg
+        self.task = make_task(data.task)
+        pad = cfg.data.batch_size
+        self.arrays: FederatedArrays = data.to_arrays(pad_multiple=pad)
+        self.max_n = self.arrays.max_client_samples
+        self.batch_size = min(cfg.data.batch_size, self.max_n)
+        self.num_classes = self.arrays.num_classes
+        self.evaluator = build_evaluator(model, self.task)
+        self.root_key = jax.random.key(cfg.seed)
+        self.local_update = self._build_local_update()
+        self._round_fn = jax.jit(self._round, donate_argnums=(0,))
+
+    def _build_local_update(self):
+        model, cfg_t = self.model, self.cfg.train
+        K = self.num_classes
+        batch_size, max_n = self.batch_size, self.max_n
+        steps_per_epoch = max_n // batch_size
+        kd_gamma = self.cfg.gan.kd_gamma
+        opt = make_client_optimizer(cfg_t)
+
+        def loss_fn(params, static, xb, yb, wb, teacher, use_t, rng):
+            """``use_t`` is a per-LABEL availability mask [K]: a sample only
+            gets the KD term if some OTHER client has contributed logits for
+            its label — without this, labels unique to this client would be
+            distilled toward softmax(zeros) = uniform."""
+            variables = {**static, "params": params}
+            logits, new_vars = model.apply_train(variables, xb, rng)
+            ce = optax.softmax_cross_entropy_with_integer_labels(logits, yb)
+            # soft-label co-distillation (fd_faug/model_trainer.py:62-68):
+            # CE against softmax of the global per-label average logits
+            t_rows = teacher[yb]  # [B, K]
+            soft = jax.nn.softmax(t_rows, axis=-1)
+            kd_ce = optax.softmax_cross_entropy(logits, soft)
+            gamma = kd_gamma * use_t[yb]  # [B] per-sample gate
+            per_row = (1 - gamma) * ce + gamma * kd_ce
+            loss = jnp.sum(per_row * wb) / jnp.maximum(jnp.sum(wb), 1.0)
+            return loss, (new_vars, logits)
+
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+        def update(variables, idx_row, mask_row, x, y, teacher, use_t, rng):
+            opt_state = opt.init(variables["params"])
+            lab_sum0 = jnp.zeros((K, K))
+            lab_cnt0 = jnp.zeros((K,))
+
+            def epoch_body(carry, ekey):
+                variables, opt_state, lab_sum, lab_cnt = carry
+                perm = jax.random.permutation(ekey, max_n)
+                order = jnp.argsort(1.0 - mask_row[perm], stable=True)
+                perm = perm[order]
+
+                def step(carry2, s):
+                    variables, opt_state, lab_sum, lab_cnt = carry2
+                    take = jax.lax.dynamic_slice_in_dim(
+                        perm, s * batch_size, batch_size
+                    )
+                    b_idx = idx_row[take]
+                    wb = mask_row[take]
+                    xb = jnp.take(x, b_idx, axis=0)
+                    yb = jnp.take(y, b_idx, axis=0)
+                    params = variables["params"]
+                    static = {
+                        k: v for k, v in variables.items() if k != "params"
+                    }
+                    (_, (new_vars, logits)), grads = grad_fn(
+                        params, static, xb, yb, wb, teacher, use_t,
+                        jax.random.fold_in(ekey, s),
+                    )
+                    updates, new_os = opt.update(grads, opt_state, params)
+                    new_vars = {
+                        **new_vars,
+                        "params": optax.apply_updates(params, updates),
+                    }
+                    valid = jnp.sum(wb) > 0
+                    sel = lambda a, b: jax.tree.map(
+                        lambda p, q: jnp.where(valid, p, q), a, b
+                    )
+                    # accumulate per-label logit sums (model_trainer.py:46-47)
+                    lab_sum = lab_sum.at[yb].add(logits * wb[:, None])
+                    lab_cnt = lab_cnt.at[yb].add(wb)
+                    return (
+                        sel(new_vars, variables), sel(new_os, opt_state),
+                        lab_sum, lab_cnt,
+                    ), None
+
+                carry2, _ = jax.lax.scan(
+                    step, (variables, opt_state, lab_sum, lab_cnt),
+                    jnp.arange(steps_per_epoch),
+                )
+                return carry2, None
+
+            ekeys = jax.vmap(lambda e: jax.random.fold_in(rng, e))(
+                jnp.arange(cfg_t.epochs)
+            )
+            (variables, _, lab_sum, lab_cnt), _ = jax.lax.scan(
+                epoch_body, (variables, opt_state, lab_sum0, lab_cnt0), ekeys
+            )
+            # per-label AVERAGE logits for the exchange
+            lab_avg = lab_sum / jnp.maximum(lab_cnt, 1.0)[:, None]
+            return variables, lab_avg, lab_cnt, jnp.sum(mask_row)
+
+        return update
+
+    def init(self) -> FDState:
+        n = self.arrays.num_clients
+        K = self.num_classes
+        return FDState(
+            model_stack=_vmap_init(
+                self.model.init,
+                jax.random.fold_in(self.root_key, 0x7FFFFFFF), n,
+            ),
+            teacher=jnp.zeros((n, K, K)),
+            has_teacher=jnp.zeros((n, K), bool),
+            round=jnp.asarray(0, jnp.int32),
+        )
+
+    def _round(self, state: FDState, arrays: FederatedArrays):
+        cfg = self.cfg.fed
+        rkey = R.round_key(self.root_key, state.round)
+        cohort = R.sample_clients(
+            jax.random.fold_in(rkey, 0), arrays.num_clients,
+            cfg.clients_per_round,
+        )
+        ckeys = jax.vmap(lambda c: R.client_key(rkey, c))(cohort)
+        mvars = _gather(state.model_stack, cohort)
+
+        mvars, lab_avg, lab_cnt, _ = jax.vmap(
+            self.local_update, in_axes=(0, 0, 0, None, None, 0, 0, 0)
+        )(
+            mvars, arrays.idx[cohort], arrays.mask[cohort], arrays.x,
+            arrays.y, state.teacher[cohort], state.has_teacher[cohort],
+            ckeys,
+        )
+
+        # leave-one-out global label averages (FD_FAug_api.py:126-138):
+        # teacher_i[l] = (sum_j avg_j[l] - avg_i[l]) / (M - 1) over
+        # contributors that saw label l
+        seen = (lab_cnt > 0).astype(jnp.float32)  # [C, K]
+        tot_sum = jnp.sum(lab_avg * seen[..., None], axis=0)  # [K, K]
+        tot_m = jnp.sum(seen, axis=0)  # [K]
+        m_other = jnp.maximum(tot_m[None] - seen, 1.0)  # [C, K]
+        loo = (tot_sum[None] - lab_avg * seen[..., None]) / m_other[..., None]
+        have = (tot_m[None] - seen) > 0  # [C, K] some other client saw l
+
+        new_teacher = state.teacher.at[cohort].set(
+            jnp.where(have[..., None], loo, state.teacher[cohort])
+        )
+        new_has = state.has_teacher.at[cohort].set(
+            jnp.logical_or(state.has_teacher[cohort], have)
+        )
+        new_state = FDState(
+            _scatter(state.model_stack, cohort, mvars),
+            new_teacher, new_has, state.round + 1,
+        )
+        return new_state, {}
+
+    def run_round(self, state: FDState):
+        return self._round_fn(state, self.arrays)
+
+    def evaluate_clients(self, state: FDState) -> dict:
+        return _evaluate_stack(
+            self.evaluator, state.model_stack, self.arrays.test_x,
+            self.arrays.test_y, self.arrays.num_clients,
+        )
+
+
+class FedArjunState(NamedTuple):
+    adapter_vars: Pytree  # global FedAvg-shared adapter
+    local_stack: Pytree  # [N, ...] private local models
+    round: jax.Array
+
+
+class FedArjunSim:
+    """FedArjun: shared adapter + private local model with bidirectional KD
+    (``federated_arjun/model_trainer.py:38-76``)."""
+
+    def __init__(
+        self,
+        adapter: FedModel,
+        local: FedModel,
+        data: FederatedData,
+        cfg: ExperimentConfig,
+    ):
+        self.adapter, self.local, self.cfg = adapter, local, cfg
+        self.task = make_task(data.task)
+        pad = cfg.data.batch_size
+        self.arrays: FederatedArrays = data.to_arrays(pad_multiple=pad)
+        self.max_n = self.arrays.max_client_samples
+        self.batch_size = min(cfg.data.batch_size, self.max_n)
+        self.local_train = build_local_update(
+            local, self.task, cfg.train, self.batch_size, self.max_n
+        )
+        self.evaluator = build_evaluator(local, self.task)
+        self.root_key = jax.random.key(cfg.seed)
+        self.kd_transfer = self._build_kd_transfer()
+        self._round_fn = jax.jit(self._round, donate_argnums=(0,))
+
+    def _build_kd_transfer(self):
+        """KD over the client's own (padded) data: student learns from a
+        frozen teacher; returns the updated student."""
+        cfg_t, g = self.cfg.train, self.cfg.gan
+        batch_size, max_n = self.batch_size, self.max_n
+        steps = max_n // batch_size
+        opt = make_client_optimizer(cfg_t)
+
+        def run(student: FedModel, teacher: FedModel):
+            def loss_fn(params, static, t_vars, xb, yb, wb, rng):
+                variables = {**static, "params": params}
+                s_logits, new_vars = student.apply_train(variables, xb, rng)
+                t_logits = jax.lax.stop_gradient(
+                    teacher.apply_eval(t_vars, xb)
+                )
+                ce = optax.softmax_cross_entropy_with_integer_labels(
+                    s_logits, yb
+                )
+                ce = jnp.sum(ce * wb) / jnp.maximum(jnp.sum(wb), 1.0)
+                kd_l = KD.soft_target(
+                    s_logits, t_logits, g.kd_temperature, w=wb
+                )
+                loss = (1 - g.kd_lambda) * ce + g.kd_lambda * kd_l
+                return loss, new_vars
+
+            grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+            def transfer(s_vars, t_vars, idx_row, mask_row, x, y, rng):
+                opt_state = opt.init(s_vars["params"])
+
+                def epoch_body(carry, ekey):
+                    variables, opt_state = carry
+                    perm = jax.random.permutation(ekey, max_n)
+                    order = jnp.argsort(1.0 - mask_row[perm], stable=True)
+                    perm = perm[order]
+
+                    def step(carry2, s):
+                        variables, opt_state = carry2
+                        take = jax.lax.dynamic_slice_in_dim(
+                            perm, s * batch_size, batch_size
+                        )
+                        b_idx = idx_row[take]
+                        wb = mask_row[take]
+                        xb = jnp.take(x, b_idx, axis=0)
+                        yb = jnp.take(y, b_idx, axis=0)
+                        params = variables["params"]
+                        static = {
+                            k: v
+                            for k, v in variables.items()
+                            if k != "params"
+                        }
+                        (_, new_vars), grads = grad_fn(
+                            params, static, t_vars, xb, yb, wb,
+                            jax.random.fold_in(ekey, s),
+                        )
+                        updates, new_os = opt.update(
+                            grads, opt_state, params
+                        )
+                        new_vars = {
+                            **new_vars,
+                            "params": optax.apply_updates(params, updates),
+                        }
+                        valid = jnp.sum(wb) > 0
+                        sel = lambda a, b: jax.tree.map(
+                            lambda p, q: jnp.where(valid, p, q), a, b
+                        )
+                        return (
+                            sel(new_vars, variables),
+                            sel(new_os, opt_state),
+                        ), None
+
+                    carry2, _ = jax.lax.scan(
+                        step, (variables, opt_state), jnp.arange(steps)
+                    )
+                    return carry2, None
+
+                ekeys = jax.vmap(lambda e: jax.random.fold_in(rng, e))(
+                    jnp.arange(max(g.kd_epochs, 1))
+                )
+                (s_vars, _), _ = jax.lax.scan(
+                    epoch_body, (s_vars, opt_state), ekeys
+                )
+                return s_vars
+
+            return transfer
+
+        return {
+            "a2l": run(self.local, self.adapter),
+            "l2a": run(self.adapter, self.local),
+        }
+
+    def init(self) -> FedArjunState:
+        k = jax.random.fold_in(self.root_key, 0x7FFFFFFF)
+        ka, kl = jax.random.split(k)
+        return FedArjunState(
+            adapter_vars=self.adapter.init(ka),
+            local_stack=_vmap_init(
+                self.local.init, kl, self.arrays.num_clients
+            ),
+            round=jnp.asarray(0, jnp.int32),
+        )
+
+    def _round(self, state: FedArjunState, arrays: FederatedArrays):
+        cfg = self.cfg.fed
+        rkey = R.round_key(self.root_key, state.round)
+        cohort = R.sample_clients(
+            jax.random.fold_in(rkey, 0), arrays.num_clients,
+            cfg.clients_per_round,
+        )
+        ckeys = jax.vmap(lambda c: R.client_key(rkey, c))(cohort)
+        l_vars = _gather(state.local_stack, cohort)
+        idx_rows = arrays.idx[cohort]
+        mask_rows = arrays.mask[cohort]
+
+        # 1. adapter -> local KD (model_trainer.py:64-66)
+        l_vars = jax.vmap(
+            self.kd_transfer["a2l"],
+            in_axes=(0, None, 0, 0, None, None, 0),
+        )(l_vars, state.adapter_vars, idx_rows, mask_rows, arrays.x,
+          arrays.y, ckeys)
+
+        # 2. train local on private data (:71)
+        l_vars, n_k, _ = jax.vmap(
+            self.local_train, in_axes=(0, 0, 0, None, None, 0)
+        )(
+            l_vars, idx_rows, mask_rows, arrays.x, arrays.y,
+            jax.vmap(lambda k: jax.random.fold_in(k, 1))(ckeys),
+        )
+
+        # 3. local -> adapter KD, then FedAvg adapters (:74-76)
+        a_stack = jax.vmap(
+            self.kd_transfer["l2a"],
+            in_axes=(None, 0, 0, 0, None, None, 0),
+        )(state.adapter_vars, l_vars, idx_rows, mask_rows, arrays.x,
+          arrays.y,
+          jax.vmap(lambda k: jax.random.fold_in(k, 2))(ckeys))
+        new_adapter = T.tree_weighted_mean(a_stack, n_k)
+
+        return (
+            FedArjunState(
+                new_adapter,
+                _scatter(state.local_stack, cohort, l_vars),
+                state.round + 1,
+            ),
+            {},
+        )
+
+    def run_round(self, state: FedArjunState):
+        return self._round_fn(state, self.arrays)
+
+    def evaluate_clients(self, state: FedArjunState) -> dict:
+        return _evaluate_stack(
+            self.evaluator, state.local_stack, self.arrays.test_x,
+            self.arrays.test_y, self.arrays.num_clients,
+        )

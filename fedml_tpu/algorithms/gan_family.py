@@ -46,19 +46,12 @@ from fedml_tpu.models.gan import GanModel
 Pytree = Any
 
 
-def _stack_gather(stack: Pytree, cohort: jax.Array) -> Pytree:
-    return jax.tree.map(lambda s: s[cohort], stack)
-
-
-def _stack_scatter(stack: Pytree, cohort: jax.Array, new: Pytree) -> Pytree:
-    return jax.tree.map(lambda s, n: s.at[cohort].set(n), stack, new)
-
-
-def _vmap_init(init_fn, root_key, num_clients):
-    keys = jax.vmap(lambda i: jax.random.fold_in(root_key, i))(
-        jnp.arange(num_clients)
-    )
-    return jax.vmap(init_fn)(keys)
+from fedml_tpu.algorithms.stack_utils import (
+    evaluate_stack as _evaluate_stack,
+    stack_gather as _stack_gather,
+    stack_scatter as _stack_scatter,
+    vmap_init as _vmap_init,
+)
 
 
 class FedGANState(NamedTuple):
@@ -315,21 +308,10 @@ class FedGDKDSim:
         return self._round_fn(state, self.arrays)
 
     def evaluate_clients(self, state: FedGDKDState) -> dict:
-        """Mean per-client accuracy on the global test set (reference
-        ``_local_test_on_all_clients``,
-        ``HeterogeneousModelBaseTrainerAPI.py:82-164``)."""
-        n = self.arrays.num_clients
-        accs, losses = [], []
-        for i in range(n):
-            cv = jax.tree.map(lambda s: s[i], state.cls_stack)
-            m = self.evaluator(cv, self.arrays.test_x, self.arrays.test_y)
-            accs.append(float(m["acc"]))
-            losses.append(float(m["loss"]))
-        return {
-            "test_acc": sum(accs) / n,
-            "test_loss": sum(losses) / n,
-            "per_client_acc": accs,
-        }
+        return _evaluate_stack(
+            self.evaluator, state.cls_stack, self.arrays.test_x,
+            self.arrays.test_y, self.arrays.num_clients,
+        )
 
     def run(self, metrics_sink=None) -> FedGDKDState:
         state = self.init()
@@ -597,10 +579,7 @@ class FedDTGSim:
         return self._round_fn(state, self.arrays)
 
     def evaluate_clients(self, state: FedDTGState) -> dict:
-        n = self.arrays.num_clients
-        accs = []
-        for i in range(n):
-            cv = jax.tree.map(lambda s: s[i], state.cls_stack)
-            m = self.evaluator(cv, self.arrays.test_x, self.arrays.test_y)
-            accs.append(float(m["acc"]))
-        return {"test_acc": sum(accs) / n, "per_client_acc": accs}
+        return _evaluate_stack(
+            self.evaluator, state.cls_stack, self.arrays.test_x,
+            self.arrays.test_y, self.arrays.num_clients,
+        )

@@ -1,0 +1,414 @@
+"""Semi-supervised and universally-aggregated federated GANs.
+
+- :class:`FedSSGANSim` — federated semi-supervised GAN (reference
+  ``fedml_api/standalone/federated_sgan/``): each client holds the shared
+  ACGAN (G + classifier-discriminator) and a mix of labelled and
+  unlabelled data; the ssgan logsumexp losses apply the supervised
+  auxiliary term only where labels exist; the WHOLE model (G+D) is
+  FedAvg-aggregated (``fedssgan_api.py:62-100``). Clients can synthesize
+  extra unlabelled data filtered by classifier confidence
+  (``model_trainer.py:317-340`` ``generate_synthetic_dataset`` with a
+  realism threshold).
+- :class:`FedUAGANSim` — UA-GAN (reference
+  ``fedml_api/standalone/federated_uagan/server.py:74-146``): ONE central
+  conditional generator; clients keep private ACGAN discriminators trained
+  on local real + central fakes; the generator step backpropagates through
+  the sample-count-weighted AVERAGE of all client discriminator outputs
+  (the "universal" discriminator). There is no discriminator averaging —
+  knowledge flows only through the aggregated outputs, so it maps onto TPU
+  as a vmapped per-client discriminator bank with a weighted mean over the
+  client axis inside one differentiable program.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from fedml_tpu.algorithms import gan_core as G
+from fedml_tpu.algorithms.base import make_client_optimizer
+from fedml_tpu.config import ExperimentConfig
+from fedml_tpu.core import random as R
+from fedml_tpu.core import tree as T
+from fedml_tpu.algorithms.stack_utils import vmap_init
+from fedml_tpu.data.federated import FederatedArrays, FederatedData
+from fedml_tpu.models.gan import GanModel
+
+Pytree = Any
+
+
+class FedSSGANState(NamedTuple):
+    gen_vars: Pytree
+    disc_vars: Pytree
+    round: jax.Array
+
+
+class FedSSGANSim:
+    """Semi-supervised federated ACGAN. ``label_fraction`` of each client's
+    samples keep labels; the rest contribute only adversarial terms."""
+
+    def __init__(
+        self,
+        gen: GanModel,
+        disc: G.DiscHandle,
+        data: FederatedData,
+        cfg: ExperimentConfig,
+        label_fraction: float = 0.5,
+    ):
+        self.gen, self.disc, self.cfg = gen, disc, cfg
+        pad = cfg.data.batch_size
+        self.arrays: FederatedArrays = data.to_arrays(pad_multiple=pad)
+        self.max_n = self.arrays.max_client_samples
+        self.batch_size = min(cfg.data.batch_size, self.max_n)
+        self.input_shape = self.arrays.x.shape[1:]
+        self.label_fraction = float(label_fraction)
+        # per-sample labelled mask over the GLOBAL train array, seeded so
+        # the labelled subset is fixed across rounds
+        mask_rng = jax.random.uniform(
+            jax.random.key(cfg.seed ^ 0x55), (self.arrays.x.shape[0],)
+        )
+        self.labelled = (mask_rng < self.label_fraction).astype(jnp.float32)
+        self.root_key = jax.random.key(cfg.seed)
+        self.local_update = self._build_local_update()
+        self._round_fn = jax.jit(self._round, donate_argnums=(0,))
+
+    def _build_local_update(self):
+        gen, disc = self.gen, self.disc
+        cfg_t, cfg_g = self.cfg.train, self.cfg.gan
+        batch_size, max_n = self.batch_size, self.max_n
+        steps = max_n // batch_size
+        g_opt = G.make_gen_optimizer(cfg_g)
+        d_opt = make_client_optimizer(cfg_t)
+        labelled = self.labelled
+
+        def g_loss_fn(g_params, g_static, d_vars, z, gl, w, rng):
+            g_vars = {**g_static, "params": g_params}
+            fakes, new_g = gen.apply_train(g_vars, z, gl)
+            out, _ = disc.apply_train(d_vars, fakes, rng)
+            return G.generator_loss_ssgan(out, gl, w), (new_g, fakes)
+
+        def d_loss_fn(d_params, d_static, fakes, gl, xb, yb, w, lab_w, rng):
+            """ssgan D loss with the supervised CE restricted to labelled
+            rows (semi-supervised GAN: unlabelled real data only feeds the
+            adversarial logsumexp terms)."""
+            d_vars = {**d_static, "params": d_params}
+            r1, r2 = jax.random.split(rng)
+            cls_fake, d1 = disc.apply_train(d_vars, fakes, r1)
+            cls_real, d2 = disc.apply_train(d1, xb, r2)
+            logz_f = jax.nn.logsumexp(cls_fake, axis=-1)
+            fake_half = 0.5 * (
+                G._ce(cls_fake, gl, w)
+                + G._masked_mean(jax.nn.softplus(logz_f), w)
+            )
+            logz_r = jax.nn.logsumexp(cls_real, axis=-1)
+            real_half = 0.5 * (
+                G._ce(cls_real, yb, lab_w)  # supervised: labelled only
+                + G._masked_mean(
+                    -logz_r + jax.nn.softplus(logz_r), w
+                )  # adversarial: all real rows
+            )
+            return fake_half + real_half, d2
+
+        g_grad = jax.value_and_grad(g_loss_fn, has_aux=True)
+        d_grad = jax.value_and_grad(d_loss_fn, has_aux=True)
+
+        def update(gen_vars, disc_vars, idx_row, mask_row, x, y, rng):
+            def epoch_body(carry, ekey):
+                g_vars, d_vars, g_os, d_os = carry
+                perm = jax.random.permutation(ekey, max_n)
+                order = jnp.argsort(1.0 - mask_row[perm], stable=True)
+                perm = perm[order]
+
+                def step(carry2, s):
+                    g_vars, d_vars, g_os, d_os = carry2
+                    take = jax.lax.dynamic_slice_in_dim(
+                        perm, s * batch_size, batch_size
+                    )
+                    b_idx = idx_row[take]
+                    wb = mask_row[take]
+                    lab_w = wb * labelled[b_idx]
+                    xb = jnp.take(x, b_idx, axis=0)
+                    yb = jnp.take(y, b_idx, axis=0)
+                    skey = jax.random.fold_in(ekey, s)
+                    kz, kl, k1, k2 = jax.random.split(skey, 4)
+                    z = gen.sample_noise(kz, batch_size)
+                    gl = gen.sample_labels(kl, batch_size)
+
+                    gp = g_vars["params"]
+                    gs = {k: v for k, v in g_vars.items() if k != "params"}
+                    (_, (new_g, fakes)), ggr = g_grad(
+                        gp, gs, d_vars, z, gl, wb, k1
+                    )
+                    gu, new_g_os = g_opt.update(ggr, g_os, gp)
+                    new_g = {**new_g, "params": optax.apply_updates(gp, gu)}
+
+                    dp = d_vars["params"]
+                    ds = {k: v for k, v in d_vars.items() if k != "params"}
+                    (_, new_d), dgr = d_grad(
+                        dp, ds, jax.lax.stop_gradient(fakes), gl, xb, yb,
+                        wb, lab_w, k2,
+                    )
+                    du, new_d_os = d_opt.update(dgr, d_os, dp)
+                    new_d = {**new_d, "params": optax.apply_updates(dp, du)}
+
+                    valid = jnp.sum(wb) > 0
+                    sel = lambda a, b: jax.tree.map(
+                        lambda p, q: jnp.where(valid, p, q), a, b
+                    )
+                    return (
+                        sel(new_g, g_vars), sel(new_d, d_vars),
+                        sel(new_g_os, g_os), sel(new_d_os, d_os),
+                    ), None
+
+                carry2, _ = jax.lax.scan(
+                    step, (g_vars, d_vars, g_os, d_os), jnp.arange(steps)
+                )
+                return carry2, None
+
+            g_os = g_opt.init(gen_vars["params"])
+            d_os = d_opt.init(disc_vars["params"])
+            ekeys = jax.vmap(lambda e: jax.random.fold_in(rng, e))(
+                jnp.arange(cfg_t.epochs)
+            )
+            (g_vars, d_vars, _, _), _ = jax.lax.scan(
+                epoch_body, (gen_vars, disc_vars, g_os, d_os), ekeys
+            )
+            return g_vars, d_vars, jnp.sum(mask_row)
+
+        return update
+
+    def init(self) -> FedSSGANState:
+        k = jax.random.fold_in(self.root_key, 0x7FFFFFFF)
+        kg, kd = jax.random.split(k)
+        return FedSSGANState(
+            gen_vars=self.gen.init(kg),
+            disc_vars=self.disc.init(kd, self.input_shape),
+            round=jnp.asarray(0, jnp.int32),
+        )
+
+    def _round(self, state: FedSSGANState, arrays: FederatedArrays):
+        cfg = self.cfg.fed
+        rkey = R.round_key(self.root_key, state.round)
+        cohort = R.sample_clients(
+            jax.random.fold_in(rkey, 0), arrays.num_clients,
+            cfg.clients_per_round,
+        )
+        ckeys = jax.vmap(lambda c: R.client_key(rkey, c))(cohort)
+        g_stack, d_stack, n_k = jax.vmap(
+            self.local_update, in_axes=(None, None, 0, 0, None, None, 0)
+        )(
+            state.gen_vars, state.disc_vars, arrays.idx[cohort],
+            arrays.mask[cohort], arrays.x, arrays.y, ckeys,
+        )
+        # whole-model FedAvg (fedssgan_api.py:96-100)
+        return (
+            FedSSGANState(
+                T.tree_weighted_mean(g_stack, n_k),
+                T.tree_weighted_mean(d_stack, n_k),
+                state.round + 1,
+            ),
+            {},
+        )
+
+    def run_round(self, state: FedSSGANState):
+        return self._round_fn(state, self.arrays)
+
+    def generate_synthetic_dataset(
+        self, state: FedSSGANState, target_size: int, seed: int = 0
+    ):
+        """Confidence-filtered synthetic data with pseudo-labels (reference
+        ``generate_synthetic_dataset``, ``model_trainer.py:322-340``):
+        returns (images, pseudo_labels, keep_mask) — static shapes, with the
+        sub-threshold rows masked out rather than dropped."""
+        k = jax.random.key(seed)
+        z = self.gen.sample_noise(k, target_size)
+        gl = self.gen.sample_labels(jax.random.fold_in(k, 1), target_size)
+        imgs = self.gen.apply_eval(state.gen_vars, z, gl)
+        logits = self.disc.apply_eval(state.disc_vars, imgs)
+        probs = jax.nn.softmax(logits, axis=-1)
+        conf = jnp.max(probs, axis=-1)
+        pseudo = jnp.argmax(probs, axis=-1)
+        keep = conf >= self.cfg.gan.pseudo_label_threshold
+        return imgs, pseudo, keep
+
+
+class FedUAGANState(NamedTuple):
+    gen_vars: Pytree
+    gen_opt_state: Any
+    disc_stack: Pytree  # [N, ...] private client discriminators
+    round: jax.Array
+
+
+class FedUAGANSim:
+    """UA-GAN: central generator vs a bank of private client
+    discriminators whose outputs are weight-averaged for the G update."""
+
+    REAL_LABEL = 1.0
+
+    def __init__(
+        self,
+        gen: GanModel,
+        disc: G.DiscHandle,
+        data: FederatedData,
+        cfg: ExperimentConfig,
+    ):
+        assert disc.has_validity_head, "UA-GAN needs an ACGAN discriminator"
+        self.gen, self.disc, self.cfg = gen, disc, cfg
+        pad = cfg.data.batch_size
+        self.arrays: FederatedArrays = data.to_arrays(pad_multiple=pad)
+        self.max_n = self.arrays.max_client_samples
+        self.batch_size = min(cfg.data.batch_size, self.max_n)
+        self.input_shape = self.arrays.x.shape[1:]
+        self.g_opt = G.make_gen_optimizer(cfg.gan)
+        self.root_key = jax.random.key(cfg.seed)
+        self.disc_update = self._build_disc_update()
+        self._round_fn = jax.jit(self._round, donate_argnums=(0,))
+
+    def _build_disc_update(self):
+        """Client discriminator epoch: ACGAN D losses on local real data vs
+        a server-provided fake batch (``federated_uagan/server.py:88-103``,
+        client ``train``)."""
+        disc = self.disc
+        cfg_t = self.cfg.train
+        batch_size, max_n = self.batch_size, self.max_n
+        steps = max_n // batch_size
+        d_opt = make_client_optimizer(cfg_t)
+
+        def loss_fn(d_params, d_static, fakes, gl, xb, yb, wb, rng):
+            d_vars = {**d_static, "params": d_params}
+            r1, r2 = jax.random.split(rng)
+            (cls_r, v_r), d1 = disc.apply_train(d_vars, xb, r1, validity=True)
+            (cls_f, v_f), d2 = disc.apply_train(
+                d1, fakes, r2, validity=True
+            )
+            loss = G.discriminator_loss_acgan(
+                cls_f, v_f, gl, cls_r, v_r, yb, wb
+            )
+            return loss, d2
+
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+        def update(d_vars, fakes, gl, idx_row, mask_row, x, y, rng):
+            opt_state = d_opt.init(d_vars["params"])
+
+            def step(carry, s):
+                d_vars, opt_state = carry
+                perm_key = jax.random.fold_in(rng, s)
+                take = jax.random.permutation(perm_key, max_n)[:batch_size]
+                b_idx = idx_row[take]
+                wb = mask_row[take]
+                xb = jnp.take(x, b_idx, axis=0)
+                yb = jnp.take(y, b_idx, axis=0)
+                dp = d_vars["params"]
+                ds = {k: v for k, v in d_vars.items() if k != "params"}
+                (_, new_d), grads = grad_fn(
+                    dp, ds, fakes, gl, xb, yb, wb,
+                    jax.random.fold_in(rng, 1000 + s),
+                )
+                updates, new_os = d_opt.update(grads, opt_state, dp)
+                new_d = {
+                    **new_d, "params": optax.apply_updates(dp, updates)
+                }
+                valid = jnp.sum(wb) > 0
+                sel = lambda a, b: jax.tree.map(
+                    lambda p, q: jnp.where(valid, p, q), a, b
+                )
+                return (sel(new_d, d_vars), sel(new_os, opt_state)), None
+
+            (d_vars, _), _ = jax.lax.scan(
+                step, (d_vars, opt_state), jnp.arange(steps)
+            )
+            return d_vars
+
+        return update
+
+    def init(self) -> FedUAGANState:
+        k = jax.random.fold_in(self.root_key, 0x7FFFFFFF)
+        kg, kd = jax.random.split(k)
+        gen_vars = self.gen.init(kg)
+        return FedUAGANState(
+            gen_vars=gen_vars,
+            gen_opt_state=self.g_opt.init(gen_vars["params"]),
+            disc_stack=vmap_init(
+                lambda k: self.disc.init(k, self.input_shape), kd,
+                self.arrays.num_clients,
+            ),
+            round=jnp.asarray(0, jnp.int32),
+        )
+
+    def _round(self, state: FedUAGANState, arrays: FederatedArrays):
+        rkey = R.round_key(self.root_key, state.round)
+        n = arrays.num_clients
+        ckeys = jax.vmap(lambda c: R.client_key(rkey, c))(jnp.arange(n))
+        counts = arrays.counts.astype(jnp.float32)
+
+        # --- discriminator phase: fakes from the CURRENT generator ---
+        kz = jax.random.fold_in(rkey, 1)
+        z = self.gen.sample_noise(kz, self.batch_size)
+        gl = self.gen.sample_labels(jax.random.fold_in(rkey, 2),
+                                    self.batch_size)
+        fakes = jax.lax.stop_gradient(
+            self.gen.apply_eval(state.gen_vars, z, gl)
+        )
+        disc_stack = jax.vmap(
+            self.disc_update, in_axes=(0, None, None, 0, 0, None, None, 0)
+        )(
+            state.disc_stack, fakes, gl, arrays.idx, arrays.mask,
+            arrays.x, arrays.y, ckeys,
+        )
+
+        # --- generator phase: grad through the weighted-average D output
+        #     (server.py:105-128, _calculate_D_ua) ---
+        z2 = self.gen.sample_noise(jax.random.fold_in(rkey, 3),
+                                   self.batch_size)
+        gl2 = self.gen.sample_labels(jax.random.fold_in(rkey, 4),
+                                     self.batch_size)
+
+        def g_loss_fn(g_params, g_static):
+            g_vars = {**g_static, "params": g_params}
+            fakes2, _ = self.gen.apply_train(g_vars, z2, gl2)
+
+            def one_disc(d_vars):
+                cls, val = self.disc.apply_eval(d_vars, fakes2, validity=True)
+                # reference averages post-sigmoid probabilities
+                # (utils/gradient.py weighted outputs); we average
+                # probabilities then convert back to a logit for the BCE
+                return jax.nn.sigmoid(val), jax.nn.softmax(cls, axis=-1)
+
+            probs, cls_probs = jax.vmap(one_disc)(disc_stack)
+            w = counts / jnp.sum(counts)
+            ua_prob = jnp.einsum("c,cbo->bo", w, probs).clip(1e-6, 1 - 1e-6)
+            ua_cls = jnp.einsum("c,cbk->bk", w, cls_probs).clip(1e-9)
+            adv = -jnp.mean(
+                self.REAL_LABEL * jnp.log(ua_prob)
+                + (1 - self.REAL_LABEL) * jnp.log1p(-ua_prob)
+            )
+            aux = -jnp.mean(
+                jnp.log(ua_cls[jnp.arange(gl2.shape[0]), gl2])
+            )
+            return 0.5 * (adv + aux)
+
+        gp = state.gen_vars["params"]
+        gs = {k: v for k, v in state.gen_vars.items() if k != "params"}
+        g_loss, ggr = jax.value_and_grad(g_loss_fn)(gp, gs)
+        gu, new_g_os = self.g_opt.update(ggr, state.gen_opt_state, gp)
+        new_gen = {**state.gen_vars, "params": optax.apply_updates(gp, gu)}
+
+        return (
+            FedUAGANState(
+                new_gen, new_g_os, disc_stack, state.round + 1
+            ),
+            {"g_loss": g_loss},
+        )
+
+    def run_round(self, state: FedUAGANState):
+        return self._round_fn(state, self.arrays)
+
+    def sample_images(self, state: FedUAGANState, n: int, seed: int = 0):
+        k = jax.random.key(seed)
+        z = self.gen.sample_noise(k, n)
+        gl = self.gen.balanced_labels(n)
+        return self.gen.apply_eval(state.gen_vars, z, gl)

@@ -1,0 +1,51 @@
+"""Shared helpers for algorithms keeping per-client model stacks.
+
+A "stack" is a pytree whose leaves carry a leading ``[num_clients]`` axis —
+the TPU-native representation of the reference's per-client stateful
+trainers (``standalone/utils/BaseClient.py:13``). Cohort selection is a
+gather, writing results back is a scatter, and per-client evaluation walks
+the leading axis.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+Pytree = Any
+
+
+def stack_gather(stack: Pytree, cohort: jax.Array) -> Pytree:
+    return jax.tree.map(lambda s: s[cohort], stack)
+
+
+def stack_scatter(stack: Pytree, cohort: jax.Array, new: Pytree) -> Pytree:
+    return jax.tree.map(lambda s, n: s.at[cohort].set(n), stack, new)
+
+
+def vmap_init(init_fn: Callable, root_key: jax.Array, n: int) -> Pytree:
+    """Independent per-client inits (the reference deep-copies a prototype;
+    independent seeds match heterogeneous stateful clients better)."""
+    keys = jax.vmap(lambda i: jax.random.fold_in(root_key, i))(jnp.arange(n))
+    return jax.vmap(init_fn)(keys)
+
+
+def evaluate_stack(
+    evaluator: Callable, stack: Pytree, test_x, test_y, n: int
+) -> dict:
+    """Mean per-client metrics on the global test set (reference
+    ``_local_test_on_all_clients``,
+    ``HeterogeneousModelBaseTrainerAPI.py:82-164``)."""
+    accs, losses = [], []
+    for i in range(n):
+        v = jax.tree.map(lambda s: s[i], stack)
+        m = evaluator(v, test_x, test_y)
+        accs.append(float(m["acc"]))
+        losses.append(float(m["loss"]))
+    return {
+        "test_acc": sum(accs) / n,
+        "test_loss": sum(losses) / n,
+        "per_client_acc": accs,
+    }

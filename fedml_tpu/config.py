@@ -111,11 +111,17 @@ class GanConfig:
     # FedSSGAN pseudo-label confidence threshold (federated_sgan
     # model_trainer realism threshold)
     pseudo_label_threshold: float = 0.9
-    # FedMD/FD+FAug public-set + digest knobs
+    # FedMD/FD+FAug public-set + digest knobs (fedmd/model_trainer.py:50-77)
     public_size: int = 1024
     digest_epochs: int = 1
-    # FD per-label logit regularizer weight (Jeong et al. FD)
-    fd_beta: float = 0.1
+    revisit_epochs: int = 1
+    pretrain_epochs_public: int = 1
+    pretrain_epochs_private: int = 1
+    # FedMD digest / FedArjun transfer regularizer weight (args.kd_lambda)
+    kd_lambda: float = 1.0
+    # FD per-label soft-label co-distillation weight (args.kd_gamma,
+    # fd_faug/model_trainer.py:68)
+    kd_gamma: float = 0.1
 
 
 @dataclasses.dataclass(frozen=True)

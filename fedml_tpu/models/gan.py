@@ -43,6 +43,40 @@ def _plan_upsampling(img_size: int, min_init: int = 4) -> tuple[int, int]:
     return n_ups, size
 
 
+class _GeneratorPyramid(nn.Module):
+    """Shared DCGAN upsampling trunk: Dense projection -> reshape ->
+    (ConvTranspose + BN + relu) x n_blocks -> ConvTranspose -> tanh
+    (the common body of reference ``ImageGenerator`` and
+    ``ConditionalImageGenerator``, ``generator.py:29-125``)."""
+
+    img_size: int
+    channels: int
+    ngf: int
+
+    @nn.compact
+    def __call__(self, gen_input, train: bool = False):
+        n_ups, init_size = _plan_upsampling(self.img_size)
+        # final ConvTranspose is one of the upsamplings; inner blocks = rest
+        n_blocks = n_ups - 1
+        first_filters = self.ngf * (2 ** n_blocks)
+        h = nn.Dense(first_filters * init_size * init_size, name="l1")(
+            gen_input
+        )
+        h = h.reshape((-1, init_size, init_size, first_filters))
+        for i in range(n_blocks):
+            feats = self.ngf * (2 ** (n_blocks - 1 - i))
+            h = nn.ConvTranspose(
+                feats, (4, 4), strides=(2, 2), padding="SAME", use_bias=False
+            )(h)
+            h = nn.BatchNorm(use_running_average=not train)(h)
+            h = nn.relu(h)
+        h = nn.ConvTranspose(
+            self.channels, (4, 4), strides=(2, 2), padding="SAME",
+            use_bias=False,
+        )(h)
+        return jnp.tanh(h)
+
+
 class ConditionalImageGenerator(nn.Module):
     """Label-conditioned DCGAN-style generator
     (reference ``ConditionalImageGenerator``, ``generator.py:72-125``).
@@ -59,26 +93,10 @@ class ConditionalImageGenerator(nn.Module):
 
     @nn.compact
     def __call__(self, z, labels, train: bool = False):
-        n_ups, init_size = _plan_upsampling(self.img_size)
-        # final ConvTranspose is one of the upsamplings; inner blocks = rest
-        n_blocks = n_ups - 1
-        first_filters = self.ngf * (2 ** n_blocks)
-
         emb = nn.Embed(self.num_classes, self.nz, name="label_emb")(labels)
-        h = nn.Dense(first_filters * init_size * init_size, name="l1")(z * emb)
-        h = h.reshape((-1, init_size, init_size, first_filters))
-        for i in range(n_blocks):
-            feats = self.ngf * (2 ** (n_blocks - 1 - i))
-            h = nn.ConvTranspose(
-                feats, (4, 4), strides=(2, 2), padding="SAME", use_bias=False
-            )(h)
-            h = nn.BatchNorm(use_running_average=not train)(h)
-            h = nn.relu(h)
-        h = nn.ConvTranspose(
-            self.channels, (4, 4), strides=(2, 2), padding="SAME",
-            use_bias=False,
-        )(h)
-        return jnp.tanh(h)
+        return _GeneratorPyramid(
+            self.img_size, self.channels, self.ngf, name="pyramid"
+        )(z * emb, train=train)
 
 
 class ImageGenerator(nn.Module):
@@ -92,23 +110,9 @@ class ImageGenerator(nn.Module):
 
     @nn.compact
     def __call__(self, z, train: bool = False):
-        n_ups, init_size = _plan_upsampling(self.img_size)
-        n_blocks = n_ups - 1
-        first_filters = self.ngf * (2 ** n_blocks)
-        h = nn.Dense(first_filters * init_size * init_size)(z)
-        h = h.reshape((-1, init_size, init_size, first_filters))
-        for i in range(n_blocks):
-            feats = self.ngf * (2 ** (n_blocks - 1 - i))
-            h = nn.ConvTranspose(
-                feats, (4, 4), strides=(2, 2), padding="SAME", use_bias=False
-            )(h)
-            h = nn.BatchNorm(use_running_average=not train)(h)
-            h = nn.relu(h)
-        h = nn.ConvTranspose(
-            self.channels, (4, 4), strides=(2, 2), padding="SAME",
-            use_bias=False,
-        )(h)
-        return jnp.tanh(h)
+        return _GeneratorPyramid(
+            self.img_size, self.channels, self.ngf, name="pyramid"
+        )(z, train=train)
 
 
 class ACGANDiscriminator(nn.Module):
