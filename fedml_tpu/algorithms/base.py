@@ -120,11 +120,33 @@ def _tag_task() -> Task:
     return Task("tag_prediction", sums)
 
 
+def _segmentation_task() -> Task:
+    """Per-pixel CE for semantic segmentation: logits [B,H,W,K], y [B,H,W];
+    accuracy = pixel accuracy (reference fedseg ``MyModelTrainer`` CE loss +
+    ``Evaluator.Pixel_Accuracy``, ``fedseg/utils.py:251``). mIoU/FWIoU come
+    from :class:`fedml_tpu.metrics.segmentation.SegEvaluator`."""
+
+    def sums(logits, y, w):
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+        ce = ce.mean(axis=(1, 2))  # per-image mean over pixels
+        correct = (jnp.argmax(logits, -1) == y).astype(jnp.float32)
+        pixels = y.shape[1] * y.shape[2]
+        return {
+            "loss_sum": jnp.sum(ce * w),
+            "correct": jnp.sum(correct.mean(axis=(1, 2)) * w * pixels),
+            "count": jnp.sum(w) * pixels,
+            "w_sum": jnp.sum(w),
+        }
+
+    return Task("segmentation", sums)
+
+
 def make_task(name: str) -> Task:
     return {
         "classification": _classification_task,
         "nwp": _nwp_task,
         "tag_prediction": _tag_task,
+        "segmentation": _segmentation_task,
     }[name]()
 
 

@@ -199,6 +199,14 @@ class FedMDSim:
             _dc.replace(cfg.train, epochs=max(1, cfg.gan.pretrain_epochs_private)),
             self.batch_size, max_n,
         )
+        # revisit = exactly revisit_epochs epochs of private CE with ONE
+        # optimizer lifetime (fedmd/model_trainer.py:76-77) — not
+        # revisit_epochs repetitions of a train.epochs-epoch run
+        self.revisit_update = build_local_update(
+            model, self.task,
+            _dc.replace(cfg.train, epochs=max(1, cfg.gan.revisit_epochs)),
+            self.batch_size, max_n,
+        )
         n_b = self.pub_size // self.batch_size
 
         def extract(variables):
@@ -259,14 +267,13 @@ class FedMDSim:
                 jax.random.fold_in(k, 1), g.digest_epochs,
             )
         )(mvars, ckeys)
-        for i in range(max(1, g.revisit_epochs)):
-            mvars, _, msums = jax.vmap(
-                self.local_update, in_axes=(0, 0, 0, None, None, 0)
-            )(
-                mvars, arrays.idx[cohort], arrays.mask[cohort],
-                arrays.x, arrays.y,
-                jax.vmap(lambda k: jax.random.fold_in(k, 2 + i))(ckeys),
-            )
+        mvars, _, msums = jax.vmap(
+            self.revisit_update, in_axes=(0, 0, 0, None, None, 0)
+        )(
+            mvars, arrays.idx[cohort], arrays.mask[cohort],
+            arrays.x, arrays.y,
+            jax.vmap(lambda k: jax.random.fold_in(k, 2))(ckeys),
+        )
 
         new_stack = _scatter(state.model_stack, cohort, mvars)
         reduced = jax.tree.map(jnp.sum, msums)

@@ -1,0 +1,155 @@
+"""Non-federated baselines: local-only training and pooled centralized.
+
+Reference equivalents:
+- ``fedml_api/standalone/baseline/`` — every client trains ONLY on its own
+  data, no communication; the lower bound for FL comparisons.
+- ``fedml_api/standalone/centralised/`` + ``fedml_api/centralized/
+  centralized_trainer.py:9`` — one model on the pooled dataset; the upper
+  bound (and the convergence-equivalence oracle partner: full-batch FedAvg
+  over all clients == centralized full-batch GD, ``CI-script-fedavg.sh:45-66``).
+
+Both reuse the compiled ``build_local_update`` hot loop; centralized is
+expressed as a single "client" owning every sample, which makes the oracle
+comparison an exact code-path match.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.algorithms.base import (
+    build_evaluator,
+    build_local_update,
+    finalize_sums,
+    make_task,
+)
+from fedml_tpu.algorithms.stack_utils import evaluate_stack, vmap_init
+from fedml_tpu.config import ExperimentConfig
+from fedml_tpu.data.federated import FederatedArrays, FederatedData
+
+Pytree = Any
+
+
+class BaselineState(NamedTuple):
+    model_stack: Pytree  # [N, ...] independent local models
+    round: jax.Array
+
+
+class BaselineSim:
+    """Local-training-only baseline (reference ``standalone/baseline``)."""
+
+    def __init__(self, model, data: FederatedData, cfg: ExperimentConfig):
+        self.model, self.cfg = model, cfg
+        self.task = make_task(data.task)
+        self.arrays: FederatedArrays = data.to_arrays(
+            pad_multiple=cfg.data.batch_size
+        )
+        max_n = self.arrays.max_client_samples
+        self.batch_size = min(cfg.data.batch_size, max_n)
+        self.local_update = build_local_update(
+            model, self.task, cfg.train, self.batch_size, max_n
+        )
+        self.evaluator = build_evaluator(model, self.task)
+        self.root_key = jax.random.key(cfg.seed)
+        self._round_fn = jax.jit(self._round, donate_argnums=(0,))
+
+    def init(self) -> BaselineState:
+        return BaselineState(
+            vmap_init(
+                self.model.init,
+                jax.random.fold_in(self.root_key, 0x7FFFFFFF),
+                self.arrays.num_clients,
+            ),
+            jnp.asarray(0, jnp.int32),
+        )
+
+    def _round(self, state: BaselineState, arrays: FederatedArrays):
+        n = arrays.num_clients
+        rkey = jax.random.fold_in(self.root_key, state.round)
+        ckeys = jax.vmap(lambda c: jax.random.fold_in(rkey, c))(
+            jnp.arange(n)
+        )
+        stack, _, msums = jax.vmap(
+            self.local_update, in_axes=(0, 0, 0, None, None, 0)
+        )(state.model_stack, arrays.idx, arrays.mask, arrays.x, arrays.y,
+          ckeys)
+        fin = finalize_sums(jax.tree.map(jnp.sum, msums))
+        return (
+            BaselineState(stack, state.round + 1),
+            {"train_loss": fin["loss"], "train_acc": fin["acc"]},
+        )
+
+    def run_round(self, state: BaselineState):
+        return self._round_fn(state, self.arrays)
+
+    def evaluate_clients(self, state: BaselineState) -> dict:
+        return evaluate_stack(
+            self.evaluator, state.model_stack, self.arrays.test_x,
+            self.arrays.test_y, self.arrays.num_clients,
+        )
+
+
+def pooled_data(data: FederatedData) -> FederatedData:
+    """Collapse a federated dataset into one pooled client (reference
+    centralized collapse, ``standalone/utils/dataset.py:149-156``)."""
+    all_train = np.concatenate(
+        [data.train_idx_map[i] for i in range(data.num_clients)]
+    )
+    all_test = np.concatenate(
+        [data.test_idx_map[i] for i in range(data.num_clients)]
+    )
+    return FederatedData(
+        data.x_train, data.y_train, data.x_test, data.y_test,
+        {0: all_train}, {0: all_test}, data.num_classes, data.task,
+    )
+
+
+class CentralizedTrainer:
+    """Pooled-data trainer (reference ``centralized_trainer.py:9``): the
+    compiled local-update over one all-owning client; one ``run_round`` =
+    ``cfg.train.epochs`` epochs of minibatch SGD."""
+
+    def __init__(self, model, data: FederatedData, cfg: ExperimentConfig):
+        self.model, self.cfg = model, cfg
+        pooled = pooled_data(data)
+        self.task = make_task(pooled.task)
+        pad = 1 if cfg.data.full_batch else cfg.data.batch_size
+        self.arrays = pooled.to_arrays(pad_multiple=pad)
+        max_n = self.arrays.max_client_samples
+        self.batch_size = max_n if cfg.data.full_batch else min(
+            cfg.data.batch_size, max_n
+        )
+        self.local_update = build_local_update(
+            model, self.task, cfg.train, self.batch_size, max_n
+        )
+        self.evaluator = build_evaluator(model, self.task)
+        self.root_key = jax.random.key(cfg.seed)
+        self._fit = jax.jit(
+            lambda v, arrays, key: self.local_update(
+                v, arrays.idx[0], arrays.mask[0], arrays.x, arrays.y, key
+            )
+        )
+
+    def init(self) -> Pytree:
+        return self.model.init(jax.random.fold_in(self.root_key, 0x7FFFFFFF))
+
+    def run_round(self, variables: Pytree, round_idx: int):
+        key = jax.random.fold_in(self.root_key, round_idx)
+        variables, _, msums = self._fit(variables, self.arrays, key)
+        fin = finalize_sums(jax.tree.map(jnp.sum, msums))
+        return variables, {
+            "train_loss": float(fin["loss"]),
+            "train_acc": float(fin["acc"]),
+        }
+
+    def evaluate(self, variables: Pytree) -> dict:
+        m = self.evaluator(variables, self.arrays.test_x, self.arrays.test_y)
+        return {k: float(v) for k, v in m.items()}
+
+    def evaluate_train(self, variables: Pytree) -> dict:
+        m = self.evaluator(variables, self.arrays.x, self.arrays.y)
+        return {k: float(v) for k, v in m.items()}

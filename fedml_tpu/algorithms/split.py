@@ -1,0 +1,700 @@
+"""Split-compute FL: FedGKT, SplitNN, and classical vertical FL.
+
+These are the reference's model-parallel-across-trust-boundary algorithms
+(SURVEY.md §2.7): activations/logits/features cross the client-server
+boundary instead of weights. In the compiled simulator the boundary is an
+explicit array handoff between separately-optimized parameter groups — the
+same cut where a multi-host deployment ships tensors over ICI/DCN via the
+transport layer.
+
+- **FedGKT** (``fedml_api/distributed/fedgkt/``): client trains a small
+  edge model with ``CE + alpha*KL(client_logits, server_logits)``
+  (``GKTClientTrainer.py:73-78``), uploads extracted feature maps +
+  logits; the server trains a large model on the features with
+  ``KL(server_out, client_logits) + alpha*CE``
+  (``GKTServerTrainer.py:261-263`` — note the asymmetric weighting) and
+  returns per-sample server logits for the next client round.
+- **SplitNN** (``fedml_api/distributed/split_nn/``): clients own the lower
+  layers, the server the upper; every batch crosses the boundary forward
+  (activations) and backward (gradients) (``client.py:24-34``,
+  ``server.py:40-57``); clients take turns in a ring.
+- **Vertical FL** (``fedml_api/standalone/classical_vertical_fl/``):
+  feature-partitioned parties; the guest holds labels, sums the parties'
+  logit components, computes the BCE loss, and returns the common gradient
+  (``vfl.py:21-75``, ``party_models.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from fedml_tpu.algorithms import kd as KD
+from fedml_tpu.algorithms.base import make_client_optimizer
+from fedml_tpu.algorithms.stack_utils import stack_gather, vmap_init
+from fedml_tpu.config import ExperimentConfig
+from fedml_tpu.core import random as R
+from fedml_tpu.data.federated import FederatedArrays, FederatedData
+
+Pytree = Any
+
+
+# GKT's ``KL_Loss`` (``fedgkt/utils.py:75-95``) is the same T^2-scaled
+# batchmean KL as the Hinton soft target; one implementation lives in
+# fedml_tpu.algorithms.kd (the +1e-7 in the reference guards log(0);
+# log-softmax there is exact).
+kl_temperature = KD.soft_target
+
+
+# ---------------------------------------------------------------------------
+# FedGKT
+# ---------------------------------------------------------------------------
+
+
+class FedGKTState(NamedTuple):
+    client_stack: Pytree  # [N, ...] edge models
+    server_vars: Pytree
+    server_opt_state: Any
+    server_logits: jax.Array  # [N_total, K] teacher logits per train sample
+    has_server_logits: jax.Array  # scalar bool
+    round: jax.Array
+
+
+class FedGKTSim:
+    """Group Knowledge Transfer on one compiled graph per round.
+
+    All clients participate each round (the reference is cross-silo:
+    ``GKTServerTrainer`` keeps every client's features). Feature maps for
+    the full train set are rematerialized per round from the current edge
+    models instead of being stored host-side — on TPU the recompute is
+    cheaper than the HBM for a stored ``[N, H, W, C]`` bank plus transfers.
+    """
+
+    def __init__(
+        self,
+        client_model,  # GKTClientResNet-like: (x) -> (features, logits)
+        server_model,  # GKTServerResNet-like: (features) -> logits
+        data: FederatedData,
+        cfg: ExperimentConfig,
+        temperature: float = 3.0,
+        alpha: float = 1.0,
+    ):
+        self.client_model = client_model
+        self.server_model = server_model
+        self.cfg = cfg
+        self.T = float(temperature)
+        self.alpha = float(alpha)
+        pad = cfg.data.batch_size
+        self.arrays: FederatedArrays = data.to_arrays(pad_multiple=pad)
+        self.max_n = self.arrays.max_client_samples
+        self.batch_size = min(cfg.data.batch_size, self.max_n)
+        self.num_classes = self.arrays.num_classes
+        self.input_shape = self.arrays.x.shape[1:]
+        self.n_total = self.arrays.x.shape[0]
+        self.c_opt = make_client_optimizer(cfg.train)
+        self.s_opt = make_client_optimizer(cfg.train)
+        self.root_key = jax.random.key(cfg.seed)
+        self._round_fn = jax.jit(self._round, donate_argnums=(0,))
+
+    # -- model plumbing -----------------------------------------------------
+    def _client_init(self, rng):
+        dummy = jnp.zeros((1,) + tuple(self.input_shape), jnp.float32)
+        return self.client_model.init({"params": rng}, dummy, train=False)
+
+    def _client_apply_train(self, variables, x):
+        (features, logits), mut = self.client_model.apply(
+            variables, x, train=True, mutable=["batch_stats"]
+        )
+        return features, logits, {**variables, **mut}
+
+    def _client_apply_eval(self, variables, x):
+        return self.client_model.apply(variables, x, train=False)
+
+    def _server_init(self, rng, feat_shape):
+        dummy = jnp.zeros((1,) + tuple(feat_shape), jnp.float32)
+        return self.server_model.init({"params": rng}, dummy, train=False)
+
+    def _server_apply_train(self, variables, f):
+        logits, mut = self.server_model.apply(
+            variables, f, train=True, mutable=["batch_stats"]
+        )
+        return logits, {**variables, **mut}
+
+    def _server_apply_eval(self, variables, f):
+        return self.server_model.apply(variables, f, train=False)
+
+    # -- phases -------------------------------------------------------------
+    def _client_phase(self, c_vars, idx_row, mask_row, x, y, s_logits,
+                      use_kd, rng):
+        """Edge training: CE + alpha*KL to the server's per-sample logits
+        (``GKTClientTrainer.py:66-90``)."""
+        steps = self.max_n // self.batch_size
+
+        def loss_fn(params, static, xb, yb, tb, wb):
+            variables = {**static, "params": params}
+            _, logits, new_vars = self._client_apply_train(variables, xb)
+            ce = optax.softmax_cross_entropy_with_integer_labels(logits, yb)
+            ce = jnp.sum(ce * wb) / jnp.maximum(jnp.sum(wb), 1.0)
+            kd = kl_temperature(logits, tb, self.T)
+            loss = ce + jnp.where(use_kd, self.alpha, 0.0) * kd
+            return loss, new_vars
+
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+        def epoch_body(carry, ekey):
+            variables, opt_state = carry
+            perm = jax.random.permutation(ekey, self.max_n)
+            order = jnp.argsort(1.0 - mask_row[perm], stable=True)
+            perm = perm[order]
+
+            def step(carry2, s):
+                variables, opt_state = carry2
+                take = jax.lax.dynamic_slice_in_dim(
+                    perm, s * self.batch_size, self.batch_size
+                )
+                b_idx = idx_row[take]
+                wb = mask_row[take]
+                xb = jnp.take(x, b_idx, axis=0)
+                yb = jnp.take(y, b_idx, axis=0)
+                tb = jnp.take(s_logits, b_idx, axis=0)
+                params = variables["params"]
+                static = {k: v for k, v in variables.items() if k != "params"}
+                (_, new_vars), grads = grad_fn(params, static, xb, yb, tb, wb)
+                updates, new_os = self.c_opt.update(grads, opt_state, params)
+                new_vars = {
+                    **new_vars,
+                    "params": optax.apply_updates(params, updates),
+                }
+                valid = jnp.sum(wb) > 0
+                sel = lambda a, b: jax.tree.map(
+                    lambda p, q: jnp.where(valid, p, q), a, b
+                )
+                return (sel(new_vars, variables), sel(new_os, opt_state)), None
+
+            carry2, _ = jax.lax.scan(
+                step, (variables, opt_state), jnp.arange(steps)
+            )
+            return carry2, None
+
+        opt_state = self.c_opt.init(c_vars["params"])
+        ekeys = jax.vmap(lambda e: jax.random.fold_in(rng, e))(
+            jnp.arange(self.cfg.train.epochs)
+        )
+        (c_vars, _), _ = jax.lax.scan(epoch_body, (c_vars, opt_state), ekeys)
+        return c_vars
+
+    def _round(self, state: FedGKTState, arrays: FederatedArrays):
+        n = arrays.num_clients
+        rkey = R.round_key(self.root_key, state.round)
+        ckeys = jax.vmap(lambda c: R.client_key(rkey, c))(jnp.arange(n))
+
+        # 1. edge training on every client
+        client_stack = jax.vmap(
+            self._client_phase,
+            in_axes=(0, 0, 0, None, None, None, None, 0),
+        )(
+            state.client_stack, arrays.idx, arrays.mask, arrays.x, arrays.y,
+            state.server_logits, state.has_server_logits, ckeys,
+        )
+
+        # 2. feature/logit extraction for every client's samples, written
+        #    into global [N_total, ...] banks via the index maps
+        eval_bs = self.batch_size
+
+        def extract_client(c_vars, idx_row, mask_row):
+            steps = self.max_n // eval_bs
+
+            def body(_, s):
+                take = jax.lax.dynamic_slice_in_dim(
+                    idx_row, s * eval_bs, eval_bs
+                )
+                xb = jnp.take(arrays.x, take, axis=0)
+                f, lg = self._client_apply_eval(c_vars, xb)
+                return None, (f, lg)
+
+            _, (feats, logits) = jax.lax.scan(body, None, jnp.arange(steps))
+            return (
+                feats.reshape((self.max_n,) + feats.shape[2:]),
+                logits.reshape((self.max_n, -1)),
+            )
+
+        feats_all, logits_all = jax.vmap(extract_client, in_axes=(0, 0, 0))(
+            client_stack, arrays.idx, arrays.mask
+        )  # [N, max_n, ...]
+
+        flat_idx = arrays.idx.reshape(-1)
+        flat_mask = arrays.mask.reshape(-1)
+        # padded rows all carry index 0; route them to a scratch slot at
+        # position n_total so they can never clobber sample 0's features
+        safe_idx = jnp.where(
+            flat_mask > 0, flat_idx, self.n_total
+        ).astype(jnp.int32)
+        feat_bank = jnp.zeros(
+            (self.n_total + 1,) + feats_all.shape[2:], feats_all.dtype
+        )
+        feat_bank = feat_bank.at[safe_idx].set(
+            feats_all.reshape((-1,) + feats_all.shape[2:])
+        )[: self.n_total]
+        cl_bank = jnp.zeros((self.n_total + 1, self.num_classes))
+        cl_bank = cl_bank.at[safe_idx].set(
+            logits_all.reshape((-1, self.num_classes))
+        )[: self.n_total]
+
+        # 3. server training over the whole feature bank
+        #    (GKTServerTrainer.train_and_eval: epochs over all clients'
+        #    batches; loss = KL + alpha*CE, :255-263)
+        s_bs = self.batch_size
+        pad = (-self.n_total) % s_bs
+        n_srv = self.n_total + pad
+
+        def s_loss_fn(params, static, fb, yb, tb, wb):
+            variables = {**static, "params": params}
+            out, new_vars = self._server_apply_train(variables, fb)
+            kd = kl_temperature(out, tb, self.T)
+            ce = optax.softmax_cross_entropy_with_integer_labels(out, yb)
+            ce = jnp.sum(ce * wb) / jnp.maximum(jnp.sum(wb), 1.0)
+            return kd + self.alpha * ce, new_vars
+
+        s_grad = jax.value_and_grad(s_loss_fn, has_aux=True)
+        skey = jax.random.fold_in(rkey, 0x5EAF)
+
+        def s_epoch(carry, ekey):
+            variables, opt_state = carry
+            perm = jax.random.permutation(ekey, n_srv) % self.n_total
+
+            def step(carry2, s):
+                variables, opt_state = carry2
+                take = jax.lax.dynamic_slice_in_dim(perm, s * s_bs, s_bs)
+                fb = jnp.take(feat_bank, take, axis=0)
+                yb = jnp.take(arrays.y, take, axis=0)
+                tb = jnp.take(cl_bank, take, axis=0)
+                wb = jnp.ones((s_bs,))
+                params = variables["params"]
+                static = {
+                    k: v for k, v in variables.items() if k != "params"
+                }
+                (_, new_vars), grads = s_grad(params, static, fb, yb, tb, wb)
+                updates, new_os = self.s_opt.update(
+                    grads, opt_state, params
+                )
+                new_vars = {
+                    **new_vars,
+                    "params": optax.apply_updates(params, updates),
+                }
+                return (new_vars, new_os), None
+
+            carry2, _ = jax.lax.scan(
+                step, (variables, opt_state), jnp.arange(n_srv // s_bs)
+            )
+            return carry2, None
+
+        ekeys = jax.vmap(lambda e: jax.random.fold_in(skey, e))(
+            jnp.arange(self.cfg.train.epochs)
+        )
+        (server_vars, server_os), _ = jax.lax.scan(
+            s_epoch, (state.server_vars, state.server_opt_state), ekeys
+        )
+
+        # 4. server logits back to clients (GKTServerTrainer
+        #    get_global_logits) — scan, not an unrolled python loop, so the
+        #    compiled program size is independent of dataset size
+        fb_padded = jnp.concatenate(
+            [feat_bank,
+             jnp.zeros((pad,) + feat_bank.shape[1:], feat_bank.dtype)]
+        )
+
+        def srv_logits(_, s):
+            fb = jax.lax.dynamic_slice_in_dim(fb_padded, s * s_bs, s_bs)
+            return None, self._server_apply_eval(server_vars, fb)
+
+        _, parts = jax.lax.scan(
+            srv_logits, None, jnp.arange(n_srv // s_bs)
+        )
+        new_server_logits = parts.reshape(n_srv, -1)[: self.n_total]
+
+        return (
+            FedGKTState(
+                client_stack, server_vars, server_os, new_server_logits,
+                jnp.asarray(True), state.round + 1,
+            ),
+            {},
+        )
+
+    # -- public API ---------------------------------------------------------
+    def init(self) -> FedGKTState:
+        k = jax.random.fold_in(self.root_key, 0x7FFFFFFF)
+        kc, ks = jax.random.split(k)
+        client_stack = vmap_init(
+            self._client_init, kc, self.arrays.num_clients
+        )
+        c0 = jax.tree.map(lambda s: s[0], client_stack)
+        f, _ = self._client_apply_eval(
+            c0, jnp.zeros((1,) + tuple(self.input_shape))
+        )
+        server_vars = self._server_init(ks, f.shape[1:])
+        return FedGKTState(
+            client_stack=client_stack,
+            server_vars=server_vars,
+            server_opt_state=self.s_opt.init(server_vars["params"]),
+            server_logits=jnp.zeros((self.n_total, self.num_classes)),
+            has_server_logits=jnp.asarray(False),
+            round=jnp.asarray(0, jnp.int32),
+        )
+
+    def run_round(self, state: FedGKTState):
+        return self._round_fn(state, self.arrays)
+
+    def evaluate(self, state: FedGKTState, client_idx: int = 0) -> dict:
+        """End-to-end eval: edge extractor -> server model (reference
+        evaluates the composed edge+server path on test data,
+        ``GKTServerTrainer.py:299-310``)."""
+        c_vars = jax.tree.map(lambda s: s[client_idx], state.client_stack)
+        bs = 256
+        x, y = self.arrays.test_x, self.arrays.test_y
+        n = x.shape[0]
+        correct = total = 0
+        for s in range(0, n, bs):
+            xb, yb = x[s:s + bs], y[s:s + bs]
+            f, _ = self._client_apply_eval(c_vars, xb)
+            out = self._server_apply_eval(state.server_vars, f)
+            correct += int(jnp.sum(jnp.argmax(out, -1) == yb))
+            total += xb.shape[0]
+        return {"test_acc": correct / max(total, 1)}
+
+
+# ---------------------------------------------------------------------------
+# SplitNN
+# ---------------------------------------------------------------------------
+
+
+class SplitNNState(NamedTuple):
+    client_stack: Pytree  # [N, ...] lower stacks (per client)
+    server_vars: Pytree
+    server_opt_state: Any
+    round: jax.Array
+
+
+class SplitNNSim:
+    """Split learning ring: clients sequentially train their epoch; every
+    batch does fwd acts -> server loss -> grads back across the cut
+    (``split_nn/client.py:24-34``, ``server.py:40-57``). The server weights
+    and optimizer state persist around the ring."""
+
+    def __init__(
+        self,
+        client_model,  # lower module
+        server_model,  # upper module
+        data: FederatedData,
+        cfg: ExperimentConfig,
+    ):
+        self.client_model = client_model
+        self.server_model = server_model
+        self.cfg = cfg
+        pad = cfg.data.batch_size
+        self.arrays: FederatedArrays = data.to_arrays(pad_multiple=pad)
+        self.max_n = self.arrays.max_client_samples
+        self.batch_size = min(cfg.data.batch_size, self.max_n)
+        self.input_shape = self.arrays.x.shape[1:]
+        self.c_opt = make_client_optimizer(cfg.train)
+        self.s_opt = make_client_optimizer(cfg.train)
+        self.root_key = jax.random.key(cfg.seed)
+        self._round_fn = jax.jit(self._round, donate_argnums=(0,))
+
+    def _client_init(self, rng):
+        dummy = jnp.zeros((1,) + tuple(self.input_shape), jnp.float32)
+        return self.client_model.init({"params": rng}, dummy, train=False)
+
+    def _round(self, state: SplitNNState, arrays: FederatedArrays):
+        """One ring pass (reference: each client trains an epoch then hands
+        the semaphore to node_right, ``client.py:12-13``)."""
+        n = arrays.num_clients
+        rkey = R.round_key(self.root_key, state.round)
+        steps = self.max_n // self.batch_size
+
+        def joint_loss(c_params, s_params, c_static, s_static, xb, yb, wb):
+            c_vars = {**c_static, "params": c_params}
+            s_vars = {**s_static, "params": s_params}
+            acts = self.client_model.apply(c_vars, xb, train=True)
+            logits = self.server_model.apply(s_vars, acts, train=True)
+            ce = optax.softmax_cross_entropy_with_integer_labels(logits, yb)
+            loss = jnp.sum(ce * wb) / jnp.maximum(jnp.sum(wb), 1.0)
+            correct = jnp.sum(
+                (jnp.argmax(logits, -1) == yb).astype(jnp.float32) * wb
+            )
+            return loss, correct
+
+        grad_fn = jax.value_and_grad(joint_loss, argnums=(0, 1), has_aux=True)
+
+        def one_client(carry, c):
+            server_vars, server_os, loss_sum, correct_sum, n_sum = carry
+            c_vars = stack_gather(state.client_stack, c)
+            idx_row = arrays.idx[c]
+            mask_row = arrays.mask[c]
+            ckey = R.client_key(rkey, c)
+            c_os = self.c_opt.init(c_vars["params"])
+
+            def step(carry2, s):
+                c_vars, c_os, server_vars, server_os, ls, cs, ns = carry2
+                perm = jax.random.permutation(ckey, self.max_n)
+                order = jnp.argsort(1.0 - mask_row[perm], stable=True)
+                take = jax.lax.dynamic_slice_in_dim(
+                    perm[order], s * self.batch_size, self.batch_size
+                )
+                b_idx = idx_row[take]
+                wb = mask_row[take]
+                xb = jnp.take(arrays.x, b_idx, axis=0)
+                yb = jnp.take(arrays.y, b_idx, axis=0)
+                cp, sp = c_vars["params"], server_vars["params"]
+                c_static = {
+                    k: v for k, v in c_vars.items() if k != "params"
+                }
+                s_static = {
+                    k: v for k, v in server_vars.items() if k != "params"
+                }
+                (loss, correct), (cg, sg) = grad_fn(
+                    cp, sp, c_static, s_static, xb, yb, wb
+                )
+                cu, new_c_os = self.c_opt.update(cg, c_os, cp)
+                su, new_s_os = self.s_opt.update(sg, server_os, sp)
+                new_c = {**c_vars, "params": optax.apply_updates(cp, cu)}
+                new_s = {
+                    **server_vars, "params": optax.apply_updates(sp, su)
+                }
+                valid = jnp.sum(wb) > 0
+                sel = lambda a, b: jax.tree.map(
+                    lambda p, q: jnp.where(valid, p, q), a, b
+                )
+                return (
+                    sel(new_c, c_vars), sel(new_c_os, c_os),
+                    sel(new_s, server_vars), sel(new_s_os, server_os),
+                    ls + jnp.where(valid, loss, 0.0), cs + correct,
+                    ns + jnp.sum(wb),
+                ), None
+
+            (c_vars, _, server_vars, server_os, loss_sum, correct_sum,
+             n_sum), _ = jax.lax.scan(
+                step,
+                (c_vars, c_os, server_vars, server_os, loss_sum,
+                 correct_sum, n_sum),
+                jnp.arange(steps),
+            )
+            return (server_vars, server_os, loss_sum, correct_sum, n_sum), c_vars
+
+        # sequential ring: python loop over clients (n is static & small in
+        # the split setting — the reference caps it at the silo count)
+        server_vars, server_os = state.server_vars, state.server_opt_state
+        loss_sum = jnp.asarray(0.0)
+        correct_sum = jnp.asarray(0.0)
+        n_sum = jnp.asarray(0.0)
+        new_client_vars = []
+        for c in range(n):
+            (server_vars, server_os, loss_sum, correct_sum, n_sum), c_vars = (
+                one_client(
+                    (server_vars, server_os, loss_sum, correct_sum, n_sum),
+                    c,
+                )
+            )
+            new_client_vars.append(c_vars)
+        new_stack = jax.tree.map(
+            lambda *leaves: jnp.stack(leaves), *new_client_vars
+        )
+        metrics = {
+            "train_loss": loss_sum / (n * steps),
+            "train_acc": correct_sum / jnp.maximum(n_sum, 1.0),
+        }
+        return (
+            SplitNNState(new_stack, server_vars, server_os, state.round + 1),
+            metrics,
+        )
+
+    def init(self) -> SplitNNState:
+        k = jax.random.fold_in(self.root_key, 0x7FFFFFFF)
+        kc, ks = jax.random.split(k)
+        client_stack = vmap_init(
+            self._client_init, kc, self.arrays.num_clients
+        )
+        c0 = jax.tree.map(lambda s: s[0], client_stack)
+        acts = self.client_model.apply(
+            c0, jnp.zeros((1,) + tuple(self.input_shape)), train=False
+        )
+        server_vars = self.server_model.init(
+            {"params": ks}, acts, train=False
+        )
+        return SplitNNState(
+            client_stack=client_stack,
+            server_vars=server_vars,
+            server_opt_state=self.s_opt.init(server_vars["params"]),
+            round=jnp.asarray(0, jnp.int32),
+        )
+
+    def run_round(self, state: SplitNNState):
+        return self._round_fn(state, self.arrays)
+
+    def evaluate(self, state: SplitNNState, client_idx: int = 0) -> dict:
+        c_vars = jax.tree.map(
+            lambda s: s[client_idx], state.client_stack
+        )
+        x, y = self.arrays.test_x, self.arrays.test_y
+        acts = self.client_model.apply(c_vars, x, train=False)
+        out = self.server_model.apply(state.server_vars, acts, train=False)
+        acc = float(jnp.mean(jnp.argmax(out, -1) == y))
+        return {"test_acc": acc}
+
+
+# ---------------------------------------------------------------------------
+# Classical vertical FL
+# ---------------------------------------------------------------------------
+
+
+class VFLState(NamedTuple):
+    party_vars: tuple  # per-party (local_model, dense_model) variables
+    opt_states: tuple
+    step: jax.Array
+
+
+class VFLSim:
+    """Vertical (feature-partitioned) logistic FL: the guest (party 0)
+    holds the labels; every party contributes a logit component computed
+    from its feature slice; loss = BCE(sum of components)
+    (``vfl.py:21-75``, ``vfl_fixture.py``). Metrics follow the reference's
+    sklearn accuracy/AUC on sigmoid(sum)."""
+
+    def __init__(
+        self,
+        party_models: Sequence[tuple],  # [(local_module, dense_module), ...]
+        feature_splits: Sequence[tuple[int, int]],  # col ranges per party
+        x_train: np.ndarray,
+        y_train: np.ndarray,
+        x_test: np.ndarray,
+        y_test: np.ndarray,
+        cfg: ExperimentConfig,
+    ):
+        assert len(party_models) == len(feature_splits)
+        self.party_models = party_models
+        self.splits = list(feature_splits)
+        self.cfg = cfg
+        self.x_train = jnp.asarray(x_train, jnp.float32)
+        self.y_train = jnp.asarray(y_train, jnp.float32)
+        self.x_test = jnp.asarray(x_test, jnp.float32)
+        self.y_test = jnp.asarray(y_test, jnp.float32)
+        self.batch_size = cfg.data.batch_size
+        self.opt = make_client_optimizer(cfg.train)
+        self.root_key = jax.random.key(cfg.seed)
+        self._step_fn = jax.jit(self._step, donate_argnums=(0,))
+
+    def _slice(self, x, p):
+        lo, hi = self.splits[p]
+        return x[:, lo:hi]
+
+    def _party_logit(self, variables, p, xb, train):
+        local_m, dense_m = self.party_models[p]
+        lv, dv = variables
+        h = local_m.apply(lv, xb, train=train)
+        return dense_m.apply(dv, h, train=train)[:, 0]
+
+    def init(self) -> VFLState:
+        k = jax.random.fold_in(self.root_key, 0x7FFFFFFF)
+        pv, os_ = [], []
+        for p, (local_m, dense_m) in enumerate(self.party_models):
+            kp = jax.random.fold_in(k, p)
+            k1, k2 = jax.random.split(kp)
+            xb = self._slice(self.x_train[:1], p)
+            lv = local_m.init({"params": k1}, xb, train=False)
+            h = local_m.apply(lv, xb, train=False)
+            dv = dense_m.init({"params": k2}, h, train=False)
+            pv.append((lv, dv))
+            os_.append(
+                (
+                    self.opt.init(lv["params"]),
+                    self.opt.init(dv["params"]),
+                )
+            )
+        return VFLState(tuple(pv), tuple(os_), jnp.asarray(0, jnp.int32))
+
+    def _step(self, state: VFLState, xb, yb):
+        """One joint batch step. The guest's sum-of-components BCE makes the
+        'common gradient' d loss/d component identical for every party
+        (``party_models.py`` receive_gradients) — autodiff through the sum
+        reproduces exactly that protocol."""
+
+        def loss_fn(all_params):
+            total = 0.0
+            for p in range(len(self.party_models)):
+                lv0, dv0 = state.party_vars[p]
+                lp, dp = all_params[p]
+                lv = {**lv0, "params": lp}
+                dv = {**dv0, "params": dp}
+                total = total + self._party_logit(
+                    (lv, dv), p, self._slice(xb, p), True
+                )
+            bce = optax.sigmoid_binary_cross_entropy(total, yb)
+            return jnp.mean(bce), total
+
+        all_params = tuple(
+            (lv["params"], dv["params"]) for lv, dv in state.party_vars
+        )
+        (loss, logit_sum), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(all_params)
+
+        new_pv, new_os = [], []
+        for p in range(len(self.party_models)):
+            lv, dv = state.party_vars[p]
+            lo, do = state.opt_states[p]
+            lg, dg = grads[p]
+            lu, new_lo = self.opt.update(lg, lo, lv["params"])
+            du, new_do = self.opt.update(dg, do, dv["params"])
+            new_pv.append(
+                (
+                    {**lv, "params": optax.apply_updates(lv["params"], lu)},
+                    {**dv, "params": optax.apply_updates(dv["params"], du)},
+                )
+            )
+            new_os.append((new_lo, new_do))
+        return (
+            VFLState(tuple(new_pv), tuple(new_os), state.step + 1),
+            loss,
+        )
+
+    def run_epoch(self, state: VFLState) -> tuple[VFLState, float]:
+        n = self.x_train.shape[0]
+        bs = self.batch_size
+        rng = np.random.default_rng(int(state.step))
+        perm = rng.permutation(n)
+        losses = []
+        for s in range(n // bs):
+            take = perm[s * bs:(s + 1) * bs]
+            state, loss = self._step_fn(
+                state, self.x_train[take], self.y_train[take]
+            )
+            losses.append(float(loss))
+        return state, float(np.mean(losses)) if losses else 0.0
+
+    def predict(self, state: VFLState, x) -> jnp.ndarray:
+        total = 0.0
+        for p in range(len(self.party_models)):
+            total = total + self._party_logit(
+                state.party_vars[p], p, self._slice(x, p), False
+            )
+        return jax.nn.sigmoid(total)
+
+    def evaluate(self, state: VFLState) -> dict:
+        probs = np.asarray(self.predict(state, self.x_test))
+        y = np.asarray(self.y_test)
+        acc = float(np.mean((probs > 0.5) == (y > 0.5)))
+        # AUC (reference vfl_fixture logs sklearn roc_auc_score)
+        order = np.argsort(probs)
+        ranks = np.empty_like(order, dtype=np.float64)
+        ranks[order] = np.arange(1, len(probs) + 1)
+        pos = y > 0.5
+        n_pos, n_neg = pos.sum(), (~pos).sum()
+        auc = (
+            (ranks[pos].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg)
+            if n_pos and n_neg
+            else float("nan")
+        )
+        return {"test_acc": acc, "test_auc": float(auc)}

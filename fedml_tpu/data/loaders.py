@@ -26,6 +26,7 @@ import struct as pystruct
 import numpy as np
 
 from fedml_tpu.config import DataConfig
+from fedml_tpu.data import partition as P
 from fedml_tpu.data.federated import FederatedData, build_federated_data
 
 # name -> (input_shape, num_classes) for image datasets
@@ -183,6 +184,56 @@ def make_fake_text_dataset(
     }
     return FederatedData(
         x_tr, y_tr, x_te, y_te, train_map, test_map, vocab, task="nwp"
+    )
+
+
+def make_fake_segmentation_dataset(
+    cfg: DataConfig,
+    img_size: int = 32,
+    num_classes: int = 4,
+    n_train: int = 512,
+    n_test: int = 64,
+) -> FederatedData:
+    """Procedural segmentation data (stand-in for pascal_voc/coco in the
+    reference fedseg path): each image contains axis-aligned class blobs on
+    background 0; the mask is the generating layout, so the task is
+    learnable by a small encoder-decoder."""
+    rng = np.random.default_rng(cfg.seed)
+
+    def gen(n):
+        x = rng.normal(0, 0.1, (n, img_size, img_size, 3)).astype(np.float32)
+        y = np.zeros((n, img_size, img_size), np.int32)
+        for i in range(n):
+            for c in range(1, num_classes):
+                cx, cy = rng.integers(0, img_size, 2)
+                h, w = rng.integers(img_size // 4, img_size // 2, 2)
+                y[i, cx:cx + h, cy:cy + w] = c
+                x[i, cx:cx + h, cy:cy + w, :] += np.eye(3)[c % 3] * c
+        return x, y
+
+    x_tr, y_tr = gen(n_train)
+    x_te, y_te = gen(n_test)
+    # a pixel mask has no single image label; partition on the per-image
+    # MAJORITY class so hetero-LDA still has a label signal to skew on
+    rng2 = np.random.default_rng(cfg.seed)
+
+    def majority(y):
+        flat = y.reshape(y.shape[0], -1)
+        return np.array(
+            [np.bincount(r, minlength=num_classes).argmax() for r in flat],
+            np.int64,
+        )
+
+    train_map = P.partition_indices_train(
+        majority(y_tr), num_classes, cfg.partition_method, cfg.num_clients,
+        cfg.partition_alpha, cfg.dataset_r, rng2,
+    )
+    test_map = P.partition_indices_test(
+        majority(y_te), num_classes, cfg.num_clients
+    )
+    return FederatedData(
+        x_tr, y_tr, x_te, y_te, train_map, test_map, num_classes,
+        task="segmentation",
     )
 
 
@@ -346,6 +397,8 @@ def load_dataset(cfg: DataConfig) -> FederatedData:
             )
         if base in ("stackoverflow_lr",):
             return make_fake_tag_dataset(cfg)
+        if base in ("pascal_voc", "coco_seg", "seg"):
+            return make_fake_segmentation_dataset(cfg)
         raise ValueError(f"unknown fake dataset: {name}")
     if name == "mnist":
         x_tr, y_tr, x_te, y_te, nc = load_mnist_arrays(cfg.data_dir)

@@ -1,0 +1,70 @@
+"""Segmentation metrics: confusion-matrix evaluator.
+
+Port of the reference ``Evaluator``
+(``fedml_api/distributed/fedseg/utils.py:246-288``): pixel accuracy,
+per-class accuracy, mean IoU, frequency-weighted IoU — all derived from one
+[K, K] confusion matrix. The matrix accumulation is a jitted bincount on
+device; metric finalization is host-side numpy (tiny)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def confusion_matrix_batch(gt, pred, num_classes: int) -> jnp.ndarray:
+    """[K, K] counts for one batch; rows = ground truth, cols = prediction
+    (reference ``_generate_matrix``, ``utils.py:276-281``). Pixels with
+    labels outside [0, K) are ignored."""
+    gt = gt.reshape(-1)
+    pred = pred.reshape(-1)
+    valid = (gt >= 0) & (gt < num_classes)
+    label = jnp.where(valid, num_classes * gt + pred, num_classes * num_classes)
+    counts = jnp.bincount(label, length=num_classes * num_classes + 1)
+    return counts[:-1].reshape(num_classes, num_classes)
+
+
+class SegEvaluator:
+    """Stateful accumulator mirroring the reference API (``add_batch`` /
+    metric getters / ``reset``)."""
+
+    def __init__(self, num_classes: int):
+        self.num_classes = num_classes
+        self._cm_fn = jax.jit(
+            lambda g, p: confusion_matrix_batch(g, p, num_classes)
+        )
+        self.reset()
+
+    def reset(self):
+        self.confusion = np.zeros((self.num_classes, self.num_classes))
+
+    def add_batch(self, gt, pred):
+        self.confusion += np.asarray(self._cm_fn(gt, pred))
+
+    def pixel_accuracy(self) -> float:
+        return float(np.diag(self.confusion).sum() / self.confusion.sum())
+
+    def pixel_accuracy_class(self) -> float:
+        with np.errstate(invalid="ignore", divide="ignore"):
+            acc = np.diag(self.confusion) / self.confusion.sum(axis=1)
+        return float(np.nanmean(acc))
+
+    def mean_iou(self) -> float:
+        with np.errstate(invalid="ignore", divide="ignore"):
+            iou = np.diag(self.confusion) / (
+                self.confusion.sum(axis=1)
+                + self.confusion.sum(axis=0)
+                - np.diag(self.confusion)
+            )
+        return float(np.nanmean(iou))
+
+    def fw_iou(self) -> float:
+        freq = self.confusion.sum(axis=1) / self.confusion.sum()
+        with np.errstate(invalid="ignore", divide="ignore"):
+            iou = np.diag(self.confusion) / (
+                self.confusion.sum(axis=1)
+                + self.confusion.sum(axis=0)
+                - np.diag(self.confusion)
+            )
+        return float((freq[freq > 0] * iou[freq > 0]).sum())

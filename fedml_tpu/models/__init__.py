@@ -83,4 +83,15 @@ def create_model(cfg: ModelConfig) -> FedModel:
         )
     if name in ("tag_lr", "stackoverflow_lr"):
         return FedModel(TagLogisticRegression(nc), cfg.input_shape)
+    if name in ("deeplab", "deeplab_lite"):  # fedseg (FedSegAPI.py:19)
+        from fedml_tpu.models.segmentation import DeepLabLite
+
+        return FedModel(
+            DeepLabLite(
+                nc,
+                encoder_features=extra.get("encoder_features", (32, 64, 128)),
+            ),
+            cfg.input_shape,
+            has_batch_stats=True,
+        )
     raise ValueError(f"unknown model: {cfg.name}")

@@ -1,0 +1,126 @@
+"""Split models for Group Knowledge Transfer (FedGKT) and SplitNN.
+
+Reference: ``fedml_api/model/cv/resnet56_gkt/`` — the ResNet-56 is cut
+after the first residual stage: the client (edge) model is conv1 + stage-1
+blocks and a small classifier head over the 16-channel feature maps
+(``resnet_client.py:112``), the server model is stages 2-3 + the final head,
+consuming the client's feature maps (``resnet_server.py:113``).
+
+TPU notes: NHWC, BasicBlocks identical to the main zoo's ResNet; the split
+boundary tensor is ``[B, 32, 32, 16]`` for CIFAR shapes — contiguous and
+cheap to ship across a mesh/DCN boundary.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from fedml_tpu.models.vision import BasicBlock
+
+
+class GKTClientResNet(nn.Module):
+    """Edge-side model: stem + one stage of BasicBlocks; returns
+    ``(features, logits)`` (reference ``resnet_client.py`` forward returns
+    ``(extracted_features, logits)``)."""
+
+    num_classes: int = 10
+    num_blocks: int = 3  # reference resnet8_56: 3 blocks client-side
+    width: int = 16
+    norm: str = "bn"
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = nn.Conv(self.width, (3, 3), padding="SAME", use_bias=False)(x)
+        h = nn.BatchNorm(use_running_average=not train)(h)
+        h = nn.relu(h)
+        for _ in range(self.num_blocks):
+            h = BasicBlock(self.width, stride=1, norm=self.norm)(
+                h, train=train
+            )
+        features = h  # [B, H, W, width]
+        pooled = jnp.mean(h, axis=(1, 2))
+        logits = nn.Dense(self.num_classes, name="head")(pooled)
+        return features, logits
+
+
+class GKTServerResNet(nn.Module):
+    """Server-side model over client feature maps: stages 2-3 of the
+    CIFAR ResNet + head (reference ``resnet_server.py:113``,
+    ``resnet56_server`` = remaining 2x9 blocks at widths 32/64)."""
+
+    num_classes: int = 10
+    blocks_per_stage: Sequence[int] = (9, 9)
+    widths: Sequence[int] = (32, 64)
+    norm: str = "bn"
+
+    @nn.compact
+    def __call__(self, features, train: bool = False):
+        h = features
+        for stage, (n, w) in enumerate(
+            zip(self.blocks_per_stage, self.widths)
+        ):
+            for b in range(n):
+                h = BasicBlock(w, stride=2 if b == 0 else 1, norm=self.norm)(
+                    h, train=train
+                )
+        h = jnp.mean(h, axis=(1, 2))
+        return nn.Dense(self.num_classes, name="head")(h)
+
+
+class SplitClientNet(nn.Module):
+    """SplitNN lower stack (reference ``split_nn/client.py``: clients own
+    the first layers up to the cut)."""
+
+    features: Sequence[int] = (32, 64)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = x
+        for f in self.features:
+            h = nn.Conv(f, (3, 3), strides=(2, 2), padding="SAME")(h)
+            h = nn.relu(h)
+        return h
+
+
+class SplitServerNet(nn.Module):
+    """SplitNN upper stack (reference ``split_nn/server.py:40``: server owns
+    the layers after the cut + loss)."""
+
+    num_classes: int = 10
+    hidden: int = 128
+
+    @nn.compact
+    def __call__(self, acts, train: bool = False):
+        h = acts.reshape((acts.shape[0], -1))
+        h = nn.relu(nn.Dense(self.hidden)(h))
+        return nn.Dense(self.num_classes)(h)
+
+
+class VFLLocalModel(nn.Module):
+    """Per-party feature extractor for vertical FL (reference
+    ``fedml_api/model/finance/vfl_models_standalone.py:36`` ``LocalModel``:
+    a small MLP over the party's feature slice)."""
+
+    out_dim: int = 32
+    hidden: int = 64
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = nn.relu(nn.Dense(self.hidden)(x))
+        return nn.Dense(self.out_dim)(h)
+
+
+class VFLDenseModel(nn.Module):
+    """Party logit head (reference ``vfl_models_standalone.py:6``
+    ``DenseModel``: one linear layer producing the party's logit
+    contribution; the guest sums contributions)."""
+
+    out_dim: int = 1
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return nn.Dense(self.out_dim, use_bias=self.use_bias)(x)

@@ -1,0 +1,122 @@
+"""MPC / secure-aggregation correctness tests (exact integer oracle)."""
+
+import numpy as np
+import pytest
+
+from fedml_tpu.algorithms import mpc
+
+
+P = mpc.P_DEFAULT
+
+
+def test_mod_inv():
+    rng = np.random.default_rng(0)
+    a = rng.integers(1, int(P), 32)
+    inv = mpc.mod_inv(a, P)
+    assert (np.mod(a.astype(np.int64) * inv % int(P), int(P)) == 1).all()
+
+
+def test_mod_matmul_matches_bigint():
+    rng = np.random.default_rng(1)
+    a = rng.integers(0, int(P), (4, 7)).astype(np.int64)
+    b = rng.integers(0, int(P), (7, 5)).astype(np.int64)
+    ours = mpc.mod_matmul(a, b, P)
+    # python bigint oracle
+    expect = np.array(
+        [
+            [
+                sum(int(a[i, k]) * int(b[k, j]) for k in range(7)) % int(P)
+                for j in range(5)
+            ]
+            for i in range(4)
+        ],
+        np.int64,
+    )
+    np.testing.assert_array_equal(ours, expect)
+
+
+def test_lagrange_coeffs_interpolate():
+    # interpolation identity: evaluating at the beta points themselves
+    # gives the identity matrix
+    beta = np.array([1, 2, 3, 4], np.int64)
+    U = mpc.gen_lagrange_coeffs(beta, beta, P)
+    np.testing.assert_array_equal(U, np.eye(4, dtype=np.int64))
+
+
+def test_bgw_roundtrip_and_dropout():
+    rng = np.random.default_rng(2)
+    x = rng.integers(0, int(P), 11)
+    n, t = 7, 2
+    shares = mpc.bgw_encode(x, n, t, P, rng)
+    # decode from ANY t+1 subset
+    for subset in ([0, 1, 2], [4, 5, 6], [0, 3, 6], [1, 2, 3, 4, 5]):
+        rec = mpc.bgw_decode(shares[subset], np.asarray(subset), P)
+        np.testing.assert_array_equal(rec, np.mod(x, int(P)))
+
+
+def test_bgw_linearity():
+    """Sum of shares decodes to the sum of secrets (the secure-agg core)."""
+    rng = np.random.default_rng(3)
+    xs = rng.integers(0, 1000, (5, 8))
+    n, t = 6, 2
+    all_shares = np.stack(
+        [mpc.bgw_encode(xs[i], n, t, P, rng) for i in range(5)]
+    )
+    summed = np.mod(all_shares.sum(axis=0), int(P))
+    subset = [1, 3, 5]
+    rec = mpc.bgw_decode(summed[subset], np.asarray(subset), P)
+    np.testing.assert_array_equal(rec, np.mod(xs.sum(axis=0), int(P)))
+
+
+def test_lcc_roundtrip():
+    rng = np.random.default_rng(4)
+    m, d, n, k, t = 8, 5, 9, 4, 1
+    x = rng.integers(0, int(P), (m, d))
+    enc = mpc.lcc_encode(x, n, k, t, P, rng)
+    # decode needs deg*(K+T-1)+1 = K+T evaluations for deg-1 functions
+    subset = list(range(k + t))
+    rec = mpc.lcc_decode(enc[subset], n, k, t, subset, P)
+    np.testing.assert_array_equal(
+        rec.reshape(m, d), np.mod(x, int(P))
+    )
+
+
+def test_lcc_with_points_roundtrip():
+    rng = np.random.default_rng(5)
+    x = rng.integers(0, int(P), (3, 6))
+    alpha = np.array([1, 2, 3], np.int64)  # data points
+    beta = np.array([11, 12, 13, 14], np.int64)  # eval points
+    enc = mpc.lcc_encode_with_points(x, alpha, beta, P)
+    rec = mpc.lcc_decode_with_points(enc, beta, alpha, P)
+    np.testing.assert_array_equal(rec, np.mod(x, int(P)))
+
+
+def test_additive_shares():
+    rng = np.random.default_rng(6)
+    x = rng.integers(0, int(P), 13)
+    sh = mpc.additive_shares(x, 5, P, rng)
+    np.testing.assert_array_equal(
+        np.mod(sh.sum(axis=0), int(P)), np.mod(x, int(P))
+    )
+
+
+def test_quantize_roundtrip_signed():
+    v = np.array([0.5, -0.25, 1.5, -2.0, 0.0])
+    q = mpc.quantize(v, 16)
+    np.testing.assert_allclose(mpc.dequantize(q, 16), v, atol=2**-16)
+
+
+def test_secure_aggregator_exact_and_dropout_tolerant():
+    rng = np.random.default_rng(7)
+    n, d = 6, 20
+    updates = rng.normal(size=(n, d)).astype(np.float64)
+    agg = mpc.SecureAggregator(num_clients=n, threshold=2, scale_bits=16)
+    # no dropout
+    s = agg.aggregate(updates)
+    np.testing.assert_allclose(s, updates.sum(0), atol=n * 2**-15)
+    # dropout after sharing: sum still includes everyone
+    s2 = agg.aggregate(updates, dropped=[0, 5])
+    np.testing.assert_allclose(s2, updates.sum(0), atol=n * 2**-15)
+    # too many dropouts -> error
+    with pytest.raises(ValueError):
+        agg.aggregate(updates, dropped=[0, 1, 2, 3])
