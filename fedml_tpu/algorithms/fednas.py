@@ -1,0 +1,205 @@
+"""FedNAS: federated neural architecture search over the DARTS space.
+
+Reference (``fedml_api/distributed/fednas/``): each client runs DARTS
+bilevel steps locally — architecture (alpha) step on a held-out split, then
+weight step on the train split (``model/cv/darts/architect.py:13``) — and
+the server aggregates BOTH weights and alphas with sample-weighted FedAvg
+(``FedNASAggregator.py:39-41``). Search is followed by a train phase on the
+derived genotype (``run_fednas_search.sh`` / ``run_fednas_train.sh``);
+derivation here is :func:`fedml_tpu.models.darts.derive_genotype`.
+
+The architect uses the first-order DARTS approximation (reference
+``--unrolled false`` default path): alpha gradient evaluated at the current
+weights. One compiled program per round, cohort vmapped.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from fedml_tpu.algorithms.base import make_client_optimizer
+from fedml_tpu.config import ExperimentConfig
+from fedml_tpu.core import random as R
+from fedml_tpu.core import tree as T
+from fedml_tpu.data.federated import FederatedArrays, FederatedData
+from fedml_tpu.models.darts import DARTSNetwork
+
+Pytree = Any
+
+
+class FedNASState(NamedTuple):
+    variables: Pytree  # params + batch_stats + arch collections
+    round: jax.Array
+
+
+class FedNASSim:
+    """Compiled federated DARTS search."""
+
+    def __init__(
+        self,
+        model: DARTSNetwork,
+        data: FederatedData,
+        cfg: ExperimentConfig,
+        arch_lr: float = 3e-4,
+    ):
+        self.model = model
+        self.cfg = cfg
+        pad = cfg.data.batch_size
+        self.arrays: FederatedArrays = data.to_arrays(pad_multiple=pad)
+        self.max_n = self.arrays.max_client_samples
+        # the 50/50 train/val split for the architect needs at least one
+        # batch per half — cap the batch size accordingly
+        self.batch_size = max(1, min(cfg.data.batch_size, self.max_n // 2))
+        self.input_shape = self.arrays.x.shape[1:]
+        self.w_opt = make_client_optimizer(cfg.train)
+        self.a_opt = optax.adam(arch_lr)  # reference arch_lr adam
+        self.root_key = jax.random.key(cfg.seed)
+        self.local_update = self._build_local_update()
+        self._round_fn = jax.jit(self._round, donate_argnums=(0,))
+
+    def _init_vars(self, rng):
+        dummy = jnp.zeros((1,) + tuple(self.input_shape), jnp.float32)
+        return self.model.init({"params": rng}, dummy, train=False)
+
+    def _apply_train(self, variables, x):
+        out, mut = self.model.apply(
+            variables, x, train=True, mutable=["batch_stats"]
+        )
+        return out, {**variables, **mut}
+
+    def _build_local_update(self):
+        def loss_wrt(part, variables, xb, yb, wb):
+            """CE loss as a function of one collection (params | arch)."""
+
+            def f(leaf):
+                v = {**variables, part: leaf}
+                logits, new_vars = self._apply_train(v, xb)
+                ce = optax.softmax_cross_entropy_with_integer_labels(
+                    logits, yb
+                )
+                loss = jnp.sum(ce * wb) / jnp.maximum(jnp.sum(wb), 1.0)
+                return loss, new_vars
+
+            return f
+
+        def update(variables, idx_row, mask_row, x, y, rng):
+            # split the client's (padded) indices into train/val halves
+            # (reference DARTS uses a 50/50 split of local data for the
+            # architect, main_fednas local search setup)
+            half = self.max_n // 2
+
+            w_os = self.w_opt.init(variables["params"])
+            a_os = self.a_opt.init(variables["arch"])
+
+            def epoch_body(carry, ekey):
+                variables, w_os, a_os = carry
+                perm = jax.random.permutation(ekey, self.max_n)
+                order = jnp.argsort(1.0 - mask_row[perm], stable=True)
+                perm = perm[order]
+                # interleave so both halves contain real samples
+                train_half = perm[0::2]
+                val_half = perm[1::2]
+                n_steps = max(1, half // self.batch_size)
+
+                def step(carry2, s):
+                    variables, w_os, a_os = carry2
+
+                    def batch(idx_src):
+                        take = jax.lax.dynamic_slice_in_dim(
+                            idx_src, s * self.batch_size, self.batch_size
+                        )
+                        b_idx = idx_row[take]
+                        return (
+                            jnp.take(x, b_idx, axis=0),
+                            jnp.take(y, b_idx, axis=0),
+                            mask_row[take],
+                        )
+
+                    # 1. architect step on the val half
+                    #    (architect.py:13 step(), first-order)
+                    xv, yv, wv = batch(val_half)
+                    (a_loss, new_vars), a_grads = jax.value_and_grad(
+                        loss_wrt("arch", variables, xv, yv, wv),
+                        has_aux=True,
+                    )(variables["arch"])
+                    au, new_a_os = self.a_opt.update(
+                        a_grads, a_os, variables["arch"]
+                    )
+                    new_arch = optax.apply_updates(variables["arch"], au)
+                    variables2 = {**new_vars, "arch": new_arch}
+                    valid_v = jnp.sum(wv) > 0
+                    sel_v = lambda a, b: jax.tree.map(
+                        lambda p, q: jnp.where(valid_v, p, q), a, b
+                    )
+                    variables2 = sel_v(variables2, variables)
+                    a_os2 = sel_v(new_a_os, a_os)
+
+                    # 2. weight step on the train half
+                    xt, yt, wt = batch(train_half)
+                    (w_loss, new_vars2), w_grads = jax.value_and_grad(
+                        loss_wrt("params", variables2, xt, yt, wt),
+                        has_aux=True,
+                    )(variables2["params"])
+                    wu, new_w_os = self.w_opt.update(
+                        w_grads, w_os, variables2["params"]
+                    )
+                    new_params = optax.apply_updates(
+                        variables2["params"], wu
+                    )
+                    variables3 = {**new_vars2, "params": new_params}
+                    valid_t = jnp.sum(wt) > 0
+                    sel_t = lambda a, b: jax.tree.map(
+                        lambda p, q: jnp.where(valid_t, p, q), a, b
+                    )
+                    variables3 = sel_t(variables3, variables2)
+                    w_os2 = sel_t(new_w_os, w_os)
+                    return (variables3, w_os2, a_os2), None
+
+                carry2, _ = jax.lax.scan(
+                    step, (variables, w_os, a_os), jnp.arange(n_steps)
+                )
+                return carry2, None
+
+            ekeys = jax.vmap(lambda e: jax.random.fold_in(rng, e))(
+                jnp.arange(self.cfg.train.epochs)
+            )
+            (variables, _, _), _ = jax.lax.scan(
+                epoch_body, (variables, w_os, a_os), ekeys
+            )
+            return variables, jnp.sum(mask_row)
+
+        return update
+
+    def init(self) -> FedNASState:
+        return FedNASState(
+            self._init_vars(jax.random.fold_in(self.root_key, 0x7FFFFFFF)),
+            jnp.asarray(0, jnp.int32),
+        )
+
+    def _round(self, state: FedNASState, arrays: FederatedArrays):
+        cfg = self.cfg.fed
+        rkey = R.round_key(self.root_key, state.round)
+        cohort = R.sample_clients(
+            jax.random.fold_in(rkey, 0), arrays.num_clients,
+            cfg.clients_per_round,
+        )
+        ckeys = jax.vmap(lambda c: R.client_key(rkey, c))(cohort)
+        stacked, n_k = jax.vmap(
+            self.local_update, in_axes=(None, 0, 0, None, None, 0)
+        )(state.variables, arrays.idx[cohort], arrays.mask[cohort],
+          arrays.x, arrays.y, ckeys)
+        # aggregate weights AND alphas (FedNASAggregator.py:39-41)
+        new_vars = T.tree_weighted_mean(stacked, n_k)
+        return FedNASState(new_vars, state.round + 1), {}
+
+    def run_round(self, state: FedNASState):
+        return self._round_fn(state, self.arrays)
+
+    def evaluate(self, state: FedNASState) -> dict:
+        x, y = self.arrays.test_x, self.arrays.test_y
+        logits = self.model.apply(state.variables, x, train=False)
+        return {"test_acc": float(jnp.mean(jnp.argmax(logits, -1) == y))}

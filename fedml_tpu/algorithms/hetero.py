@@ -1,0 +1,329 @@
+"""Heterogeneous-model clients: the fork's ``[(model, freq)]`` config,
+bucketed for compilation.
+
+The fork assigns each client its own architecture from a JSON config
+(``experiment_client_configs/*.json``, parsed at
+``fedml_experiments/standalone/utils/model.py:64-83`` and consumed by
+``HeterogeneousModelBaseTrainerAPI.py:14``). Different architectures cannot
+share one vmap, so the TPU engine buckets clients by architecture: one
+stacked pytree + one compiled program per distinct model, a python loop
+across buckets (configs cap distinct models at ~4), and cross-bucket
+aggregation of the SHARED object (the generator for FedGDKD, the logit
+tensor for FedMD) in plain array code.
+
+Cohort sampling happens host-side with the reference's seeding
+(``np.random.seed(round_idx)``, ``HeterogeneousModelBaseTrainerAPI.py:47-57``)
+because bucket membership must be static per compiled call; each bucket's
+cohort slice is padded to the bucket's max cohort size with a validity mask.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.algorithms import gan_core as G
+from fedml_tpu.algorithms.base import build_evaluator, make_task
+from fedml_tpu.algorithms.stack_utils import vmap_init
+from fedml_tpu.config import ExperimentConfig, ModelConfig
+from fedml_tpu.core import tree as T
+from fedml_tpu.data.federated import FederatedArrays, FederatedData
+from fedml_tpu.models import create_model
+from fedml_tpu.models.base import FedModel
+from fedml_tpu.models.gan import GanModel
+
+Pytree = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class ClientModelSpec:
+    """One entry of the fork's ``client_models`` config."""
+
+    model: ModelConfig
+    freq: int
+
+
+def parse_client_config(
+    config: str | dict, num_classes: int, input_shape: tuple[int, ...]
+) -> list[ClientModelSpec]:
+    """Parse the fork's JSON client-model config
+    (``experiment_client_configs/*.json``: entries with ``model``, ``freq``,
+    optional ``layers`` for cnn_custom)."""
+    if isinstance(config, str):
+        with open(config) as f:
+            config = json.load(f)
+    specs = []
+    for entry in config["client_models"]:
+        name = entry["model"]
+        extra = []
+        if name == "cnn_custom":
+            # the fork's parameterised CNN: conv widths from the config
+            # (model/cv/cnn_custom.py:8)
+            extra = [("convs", tuple(entry["layers"]))]
+        specs.append(
+            ClientModelSpec(
+                model=ModelConfig(
+                    name=name,
+                    num_classes=num_classes,
+                    input_shape=tuple(input_shape),
+                    extra=tuple(extra),
+                ),
+                freq=int(entry["freq"]),
+            )
+        )
+    return specs
+
+
+@dataclasses.dataclass
+class Bucket:
+    """Clients sharing one architecture."""
+
+    model: FedModel
+    client_ids: np.ndarray  # global client indices in this bucket
+    stack: Pytree = None  # [len(client_ids), ...] variables
+    # members' position-in-bucket keyed by global client id
+    pos: dict | None = None
+
+    def __post_init__(self):
+        self.pos = {int(c): i for i, c in enumerate(self.client_ids)}
+
+
+def build_buckets(
+    specs: Sequence[ClientModelSpec], root_key, num_clients: int
+) -> list[Bucket]:
+    """Assign client ids to architectures in config order (the fork
+    instantiates ``freq`` clients per entry sequentially,
+    ``fedgdkd/server.py:55-64``) and merge entries with identical model
+    configs into one bucket."""
+    assert sum(s.freq for s in specs) == num_clients, (
+        "client_models freqs must sum to num_clients"
+    )
+    by_cfg: dict[ModelConfig, list[int]] = {}
+    cid = 0
+    for s in specs:
+        ids = by_cfg.setdefault(s.model, [])
+        ids.extend(range(cid, cid + s.freq))
+        cid += s.freq
+    buckets = []
+    for b_idx, (mcfg, ids) in enumerate(by_cfg.items()):
+        model = create_model(mcfg)
+        stack = vmap_init(
+            model.init, jax.random.fold_in(root_key, 0xB0 + b_idx), len(ids)
+        )
+        buckets.append(
+            Bucket(model=model, client_ids=np.asarray(ids), stack=stack)
+        )
+    return buckets
+
+
+def sample_cohort(
+    round_idx: int, num_clients: int, clients_per_round: int
+) -> np.ndarray:
+    """Reference-faithful seeded sampling
+    (``HeterogeneousModelBaseTrainerAPI._client_sampling``: seed with the
+    round index, choice without replacement)."""
+    if clients_per_round >= num_clients:
+        return np.arange(num_clients)
+    rng = np.random.default_rng(round_idx)
+    return np.sort(rng.choice(num_clients, clients_per_round, replace=False))
+
+
+def bucket_cohorts(
+    buckets: Sequence[Bucket], cohort: np.ndarray, pad_to: int
+) -> list[tuple[np.ndarray, np.ndarray]]:
+    """Split a cohort by bucket; returns per-bucket (padded member
+    positions [pad_to], validity mask [pad_to])."""
+    out = []
+    cohort_set = set(int(c) for c in cohort)
+    for b in buckets:
+        members = [b.pos[c] for c in sorted(cohort_set) if c in b.pos]
+        k = len(members)
+        padded = np.zeros(pad_to, np.int32)
+        padded[:k] = members
+        valid = np.zeros(pad_to, np.float32)
+        valid[:k] = 1.0
+        out.append((padded, valid))
+    return out
+
+
+class HeteroFedGDKD:
+    """FedGDKD with per-client heterogeneous classifiers — the fork's
+    headline configuration (``fedgdkd/server.py:18-68`` builds clients from
+    ``[(model, freq)]``). The generator is the only shared-architecture
+    object; classifiers live in per-bucket stacks.
+
+    Per round: host samples the cohort and splits it by bucket; each bucket
+    runs its compiled ssgan local update; the generator is aggregated
+    across ALL buckets weighted by n_k; the distillation set is generated
+    once; per-bucket logit extraction concatenates into the cohort-wide
+    ``[C, S, K]`` tensor for the leave-one-out teacher; per-bucket KD
+    writes classifiers back.
+    """
+
+    def __init__(
+        self,
+        gen: GanModel,
+        specs: Sequence[ClientModelSpec],
+        data: FederatedData,
+        cfg: ExperimentConfig,
+    ):
+        self.gen, self.cfg = gen, cfg
+        self.task = make_task(data.task)
+        pad = cfg.data.batch_size
+        self.arrays: FederatedArrays = data.to_arrays(pad_multiple=pad)
+        self.max_n = self.arrays.max_client_samples
+        self.batch_size = min(cfg.data.batch_size, self.max_n)
+        self.root_key = jax.random.key(cfg.seed)
+        self.buckets = build_buckets(
+            specs, self.root_key, self.arrays.num_clients
+        )
+        gan = cfg.gan
+        self.synth_size = (
+            gan.distillation_size // self.batch_size
+        ) * self.batch_size or self.batch_size
+        self.generate = jax.jit(
+            G.build_dataset_generator(gen, self.synth_size, self.batch_size)
+        )
+        self.pad_to = min(
+            cfg.fed.clients_per_round, self.arrays.num_clients
+        )
+        # per-bucket compiled phases
+        self._local, self._extract, self._kd, self._eval = [], [], [], []
+        for b in self.buckets:
+            disc = G.DiscHandle.from_fed_model(b.model)
+            lu = G.build_gan_local_update(
+                gen, disc, cfg.train, gan, self.batch_size, self.max_n,
+                mode="ssgan",
+            )
+            self._local.append(
+                jax.jit(
+                    jax.vmap(lu, in_axes=(None, 0, 0, 0, None, None, 0))
+                )
+            )
+            ex = G.build_logit_extractor(
+                disc, self.synth_size, self.batch_size
+            )
+            self._extract.append(jax.jit(jax.vmap(ex, in_axes=(0, None))))
+            kd = G.build_kd_update(
+                disc, cfg.train, gan, self.synth_size, self.batch_size
+            )
+            self._kd.append(
+                jax.jit(jax.vmap(kd, in_axes=(0, None, None, 0, 0)))
+            )
+            self._eval.append(build_evaluator(b.model, self.task))
+        self.gen_vars = self.gen.init(
+            jax.random.fold_in(self.root_key, 0x6E4)
+        )
+        self.round = 0
+
+    def run_round(self) -> dict:
+        cfg = self.cfg.fed
+        arrays = self.arrays
+        cohort = sample_cohort(
+            self.round, arrays.num_clients, cfg.clients_per_round
+        )
+        rkey = jax.random.fold_in(self.root_key, self.round)
+        per_bucket = bucket_cohorts(self.buckets, cohort, self.pad_to)
+
+        # --- GAN phase per bucket ---
+        gen_sums = None
+        n_total = 0.0
+        new_cls = []
+        for bi, (b, (members, valid)) in enumerate(
+            zip(self.buckets, per_bucket)
+        ):
+            if valid.sum() == 0:
+                new_cls.append(None)
+                continue
+            gids = b.client_ids[members]  # global client ids (padded)
+            ckeys = jax.vmap(
+                lambda c: jax.random.fold_in(rkey, c)
+            )(jnp.asarray(gids))
+            cls_vars = jax.tree.map(lambda s: s[members], b.stack)
+            g_stack, cls_vars, n_k, _ = self._local[bi](
+                self.gen_vars, cls_vars, arrays.idx[gids],
+                arrays.mask[gids], arrays.x, arrays.y, ckeys,
+            )
+            n_k = n_k * valid  # padded rows weightless
+            wsum = T.tree_weighted_sum(g_stack, n_k)
+            gen_sums = (
+                wsum if gen_sums is None else T.tree_add(gen_sums, wsum)
+            )
+            n_total += float(np.sum(np.asarray(n_k)))
+            new_cls.append((members, valid, cls_vars, n_k))
+
+        self.gen_vars = jax.tree.map(
+            lambda s: s / max(n_total, 1.0), gen_sums
+        )
+
+        # --- distillation set from the aggregated generator ---
+        synth_x, synth_y = self.generate(
+            self.gen_vars, jax.random.fold_in(rkey, 0x5EED)
+        )
+
+        # --- cohort-wide logits -> leave-one-out teachers ---
+        logits_chunks, owners = [], []
+        for bi, entry in enumerate(new_cls):
+            if entry is None:
+                continue
+            members, valid, cls_vars, _ = entry
+            lg = self._extract[bi](cls_vars, synth_x)  # [pad_to, S, K]
+            k = int(valid.sum())
+            logits_chunks.append(np.asarray(lg[:k]))
+            owners.extend((bi, m) for m in range(k))
+        logits = np.concatenate(logits_chunks, axis=0)  # [C, S, K]
+        c = logits.shape[0]
+        loo = (logits.sum(0)[None] - logits) / max(c - 1, 1)
+
+        # --- per-bucket KD with its members' teachers ---
+        offset = 0
+        for bi, entry in enumerate(new_cls):
+            if entry is None:
+                continue
+            members, valid, cls_vars, _ = entry
+            k = int(valid.sum())
+            teacher = jnp.zeros((self.pad_to,) + loo.shape[1:])
+            teacher = teacher.at[:k].set(jnp.asarray(loo[offset:offset + k]))
+            offset += k
+            gids = self.buckets[bi].client_ids[members]
+            ckeys = jax.vmap(
+                lambda cid: jax.random.fold_in(
+                    jax.random.fold_in(rkey, 0xAD), cid
+                )
+            )(jnp.asarray(gids))
+            cls_vars, _ = self._kd[bi](
+                cls_vars, synth_x, synth_y, teacher, ckeys
+            )
+            # scatter only valid members back into the bucket stack
+            b = self.buckets[bi]
+            sel = valid > 0
+            upd_members = members[sel]
+            b.stack = jax.tree.map(
+                lambda s, n: s.at[jnp.asarray(upd_members)].set(
+                    n[jnp.asarray(sel)]
+                ),
+                b.stack,
+                cls_vars,
+            )
+
+        self.round += 1
+        return {"cohort": cohort.tolist(), "num_buckets": len(self.buckets)}
+
+    def evaluate_clients(self) -> dict:
+        accs = []
+        for bi, b in enumerate(self.buckets):
+            for i in range(len(b.client_ids)):
+                v = jax.tree.map(lambda s: s[i], b.stack)
+                m = self._eval[bi](
+                    v, self.arrays.test_x, self.arrays.test_y
+                )
+                accs.append(float(m["acc"]))
+        return {
+            "test_acc": float(np.mean(accs)),
+            "per_client_acc": accs,
+        }

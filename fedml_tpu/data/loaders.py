@@ -400,10 +400,37 @@ def load_dataset(cfg: DataConfig) -> FederatedData:
         if base in ("pascal_voc", "coco_seg", "seg"):
             return make_fake_segmentation_dataset(cfg)
         raise ValueError(f"unknown fake dataset: {name}")
+    if name in ("femnist", "fed_emnist", "federated_emnist"):
+        from fedml_tpu.data.natural import load_federated_emnist
+
+        return load_federated_emnist(cfg.data_dir)
+    if name == "fed_cifar100":
+        from fedml_tpu.data.natural import load_fed_cifar100
+
+        return load_fed_cifar100(cfg.data_dir)
+    if name.startswith("leaf_"):
+        from fedml_tpu.data.natural import load_leaf_json
+
+        base = name[len("leaf_"):]
+        shapes = {"femnist": ((28, 28, 1), 62), "celeba": ((84, 84, 3), 2),
+                  "synthetic": (None, 10)}
+        if base not in shapes:
+            raise ValueError(
+                f"unsupported LEAF dataset: {base} (numeric-feature LEAF "
+                f"sets supported: {sorted(shapes)})"
+            )
+        shape, nc2 = shapes[base]
+        return load_leaf_json(cfg.data_dir, nc2, x_shape=shape)
     if name == "mnist":
         x_tr, y_tr, x_te, y_te, nc = load_mnist_arrays(cfg.data_dir)
     elif name in ("cifar10", "cifar100"):
         x_tr, y_tr, x_te, y_te, nc = load_cifar_arrays(cfg.data_dir, name)
+    elif name in ("emnist", "cinic10"):
+        raise FileNotFoundError(
+            f"offline build has no real-file reader for '{name}' (the "
+            f"reference downloads it via data/{name} scripts); use "
+            f"dataset='fake_{name}' which matches its shapes/classes"
+        )
     else:
         raise ValueError(f"unknown dataset: {cfg.dataset}")
     return build_federated_data(

@@ -1,0 +1,196 @@
+"""Natural-split federated dataset loaders: TFF h5, LEAF json, poisoning.
+
+Reference loaders re-built for device-resident arrays:
+- TFF HDF5 (FederatedEMNIST ``data_preprocessing/FederatedEMNIST/
+  data_loader.py``, fed_cifar100 ``data_preprocessing/fed_cifar100/``,
+  fed_shakespeare, stackoverflow): one h5 file per split with group
+  ``examples/<client_id>/<field>``.
+- LEAF json (femnist/shakespeare/synthetic via ``data/*/download``):
+  ``{"users": [...], "user_data": {uid: {"x": ..., "y": ...}}}``.
+- Edge-case/backdoor sets (``data_preprocessing/edge_case_examples/
+  data_loader.py``, 713 LoC): the reference downloads poisoned pickles
+  (southwest airline / ARDIS); offline we synthesize the same *shape* of
+  attack — a pixel-pattern trigger + label flip on an attacker-controlled
+  fraction — plus the targeted-task evaluation used by ``fedavg_robust``
+  (``FedAvgRobustAggregator.py:14-64``).
+
+All loaders return :class:`fedml_tpu.data.federated.FederatedData` with the
+NATURAL client split preserved (``train_idx_map`` keyed by client order).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from fedml_tpu.data.federated import FederatedData
+from fedml_tpu.data.partition import partition_indices_test
+
+
+def _natural_maps(client_arrays):
+    """Concatenate per-client arrays into global arrays + index maps."""
+    xs, ys, idx_map = [], [], {}
+    offset = 0
+    for i, (x, y) in enumerate(client_arrays):
+        xs.append(x)
+        ys.append(y)
+        idx_map[i] = np.arange(offset, offset + len(x))
+        offset += len(x)
+    return np.concatenate(xs), np.concatenate(ys), idx_map
+
+
+def load_tff_h5_pairs(path: str, x_field: str, y_field: str):
+    """Iterate (client_id, x, y) from a TFF-format h5 file."""
+    import h5py
+
+    with h5py.File(path, "r") as f:
+        ex = f["examples"]
+        for cid in ex.keys():
+            g = ex[cid]
+            yield cid, np.asarray(g[x_field]), np.asarray(g[y_field])
+
+
+def load_federated_emnist(
+    data_dir: str, num_classes: int = 62, task: str = "classification"
+) -> FederatedData:
+    """FederatedEMNIST natural split (reference
+    ``FederatedEMNIST/data_loader.py``: h5 files
+    ``fed_emnist_train.h5`` / ``fed_emnist_test.h5``, fields
+    pixels/label)."""
+    train_p = os.path.join(data_dir, "fed_emnist_train.h5")
+    test_p = os.path.join(data_dir, "fed_emnist_test.h5")
+    _require(train_p, "fake_femnist")
+    train, test = [], []
+    for _, x, y in load_tff_h5_pairs(train_p, "pixels", "label"):
+        train.append((x[..., None].astype(np.float32), y.astype(np.int32)))
+    for _, x, y in load_tff_h5_pairs(test_p, "pixels", "label"):
+        test.append((x[..., None].astype(np.float32), y.astype(np.int32)))
+    x_tr, y_tr, tr_map = _natural_maps(train)
+    x_te, y_te, _ = _natural_maps(test)
+    te_map = partition_indices_test(y_te, num_classes, len(tr_map))
+    return FederatedData(
+        x_tr, y_tr, x_te, y_te, tr_map, te_map, num_classes, task
+    )
+
+
+def load_fed_cifar100(data_dir: str) -> FederatedData:
+    """fed_cifar100 (Pachinko natural split; reference
+    ``fed_cifar100/data_loader.py``: h5 fields image/label)."""
+    train_p = os.path.join(data_dir, "fed_cifar100_train.h5")
+    test_p = os.path.join(data_dir, "fed_cifar100_test.h5")
+    _require(train_p, "fake_fed_cifar100")
+    train, test = [], []
+    for _, x, y in load_tff_h5_pairs(train_p, "image", "label"):
+        train.append(
+            (x.astype(np.float32) / 255.0, y.astype(np.int32))
+        )
+    for _, x, y in load_tff_h5_pairs(test_p, "image", "label"):
+        test.append((x.astype(np.float32) / 255.0, y.astype(np.int32)))
+    x_tr, y_tr, tr_map = _natural_maps(train)
+    x_te, y_te, _ = _natural_maps(test)
+    te_map = partition_indices_test(y_te, 100, len(tr_map))
+    return FederatedData(x_tr, y_tr, x_te, y_te, tr_map, te_map, 100)
+
+
+def load_leaf_json(
+    data_dir: str,
+    num_classes: int,
+    task: str = "classification",
+    x_shape: tuple | None = None,
+) -> FederatedData:
+    """LEAF json splits (reference femnist/shakespeare download scripts):
+    ``train/*.json`` + ``test/*.json`` with users/user_data."""
+
+    def read_split(split):
+        out = {}
+        d = os.path.join(data_dir, split)
+        _require(d, "fake_femnist")
+        for fn in sorted(os.listdir(d)):
+            if not fn.endswith(".json"):
+                continue
+            with open(os.path.join(d, fn)) as f:
+                blob = json.load(f)
+            for uid in blob["users"]:
+                ud = blob["user_data"][uid]
+                x = np.asarray(ud["x"], np.float32)
+                if x_shape is not None:
+                    x = x.reshape((-1,) + tuple(x_shape))
+                out[uid] = (x, np.asarray(ud["y"], np.int32))
+        return out
+
+    train = read_split("train")
+    test = read_split("test")
+    uids = sorted(train.keys())
+    x_tr, y_tr, tr_map = _natural_maps([train[u] for u in uids])
+    x_te, y_te, te_map = _natural_maps(
+        [test.get(u, (np.zeros((0,) + x_tr.shape[1:], np.float32),
+                      np.zeros((0,), np.int32))) for u in uids]
+    )
+    return FederatedData(
+        x_tr, y_tr, x_te, y_te, tr_map, te_map, num_classes, task
+    )
+
+
+def _require(path: str, fake_name: str):
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"{path} not found. Download it with the reference's data "
+            f"scripts, or use dataset='{fake_name}' for offline runs."
+        )
+
+
+# ---------------------------------------------------------------------------
+# Backdoor / edge-case poisoning (fedavg_robust evaluation)
+# ---------------------------------------------------------------------------
+
+
+def add_pixel_trigger(x: np.ndarray, size: int = 3) -> np.ndarray:
+    """Stamp a bright square trigger in the bottom-right corner."""
+    x = x.copy()
+    x[..., -size:, -size:, :] = x.max()
+    return x
+
+
+def make_backdoor_dataset(
+    data: FederatedData,
+    target_label: int = 0,
+    poison_fraction: float = 0.5,
+    attacker_clients: tuple[int, ...] = (0,),
+    trigger_size: int = 3,
+    seed: int = 0,
+) -> tuple[FederatedData, np.ndarray, np.ndarray]:
+    """Inject a pixel-pattern backdoor into the attacker clients' samples
+    (the offline analog of the reference's edge-case poisoned sets,
+    ``edge_case_examples/data_loader.py``). Returns
+    ``(poisoned_data, trigger_test_x, trigger_test_y)`` where the trigger
+    test set measures the TARGETED task (reference poisoned-task ``test``,
+    ``fedavg_robust/FedAvgRobustAggregator.py:14-64``)."""
+    rng = np.random.default_rng(seed)
+    x = data.x_train.copy()
+    y = data.y_train.copy()
+    for c in attacker_clients:
+        idx = data.train_idx_map[c]
+        n_poison = int(len(idx) * poison_fraction)
+        chosen = rng.choice(idx, n_poison, replace=False)
+        x[chosen] = add_pixel_trigger(x[chosen], trigger_size)
+        y[chosen] = target_label
+    poisoned = FederatedData(
+        x, y, data.x_test, data.y_test, data.train_idx_map,
+        data.test_idx_map, data.num_classes, data.task,
+    )
+    # targeted-task eval: every test image with the trigger should NOT be
+    # classified as target_label by a clean model
+    trig_x = add_pixel_trigger(data.x_test, trigger_size)
+    trig_y = np.full(len(trig_x), target_label, np.int32)
+    return poisoned, trig_x, trig_y
+
+
+def backdoor_success_rate(model, variables, trig_x, trig_y) -> float:
+    """Fraction of triggered inputs classified as the attacker's target."""
+    import jax.numpy as jnp
+
+    logits = model.apply_eval(variables, jnp.asarray(trig_x))
+    pred = np.asarray(jnp.argmax(logits, -1))
+    return float(np.mean(pred == trig_y))

@@ -35,6 +35,20 @@ def create_model(cfg: ModelConfig) -> FedModel:
         return FedModel(CNNDropOut(nc), cfg.input_shape, has_dropout=True)
     if name == "cnn_fedavg":
         return FedModel(CNNOriginalFedAvg(nc), cfg.input_shape)
+    if name == "cnn_custom":
+        # fork's parameterised CNN with conv widths from the client config
+        # ("layers" entries in experiment_client_configs/*.json;
+        # model/cv/cnn_custom.py:8)
+        return FedModel(
+            CNNParameterised(
+                nc,
+                tuple(extra.get("convs", (16, 32))),
+                tuple(extra.get("denses", (128,))),
+                extra.get("dropout", 0.0),
+            ),
+            cfg.input_shape,
+            has_dropout=extra.get("dropout", 0.0) > 0,
+        )
     if name in ("cnn_small", "cnn_medium", "cnn_large"):
         plans = {
             "cnn_small": ((16, 32), (64,)),
@@ -69,6 +83,37 @@ def create_model(cfg: ModelConfig) -> FedModel:
         )
     if name == "vgg11":
         return FedModel(VGG(nc), cfg.input_shape)
+    if name == "mobilenet_v3":
+        from fedml_tpu.models.vision_extra import MobileNetV3
+
+        return FedModel(
+            MobileNetV3(nc, extra.get("width_mult", 1.0)),
+            cfg.input_shape, has_batch_stats=True,
+        )
+    if name.startswith("efficientnet"):
+        from fedml_tpu.models.vision_extra import EfficientNet
+
+        # efficientnet-b0..b7 compound coefficients
+        # (reference efficientnet_utils.py efficientnet_params)
+        params = {
+            "b0": (1.0, 1.0), "b1": (1.0, 1.1), "b2": (1.1, 1.2),
+            "b3": (1.2, 1.4), "b4": (1.4, 1.8), "b5": (1.6, 2.2),
+            "b6": (1.8, 2.6), "b7": (2.0, 3.1),
+        }
+        suffix = name[len("efficientnet"):].lstrip("-_") or "b0"
+        if suffix not in params:
+            raise ValueError(
+                f"unknown efficientnet variant: {cfg.name} (use "
+                f"efficientnet-b0 .. efficientnet-b7)"
+            )
+        w, d = params[suffix]
+        return FedModel(
+            EfficientNet(nc, w, d), cfg.input_shape, has_batch_stats=True
+        )
+    if name == "lenet":
+        from fedml_tpu.models.vision_extra import LeNet
+
+        return FedModel(LeNet(nc), cfg.input_shape)
     if name in ("rnn", "char_lstm"):  # shakespeare
         return FedModel(
             CharLSTM(vocab_size=extra.get("vocab_size", 90)),

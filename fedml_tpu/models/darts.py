@@ -1,0 +1,227 @@
+"""DARTS search space for FedNAS.
+
+TPU-native redesign of the reference DARTS stack
+(``fedml_api/model/cv/darts/``: ``operations.py`` primitive ops,
+``model_search.py:172`` ``Network`` with mixed ops,
+``genotypes.py`` named architectures, ``model.py:111`` fixed network).
+
+Architecture parameters (alphas) live in a separate flax collection
+``"arch"`` so the bilevel optimizer can address weights and alphas
+independently (the reference keeps ``arch_parameters`` apart from model
+weights, ``model_search.py:230-240``). A MixedOp evaluates ALL candidate
+ops and contracts them with softmax(alpha) — on TPU every candidate runs as
+one fused batched graph, which XLA overlaps far better than the
+reference's per-op python loop.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+PRIMITIVES = (
+    "none",
+    "skip_connect",
+    "avg_pool_3x3",
+    "max_pool_3x3",
+    "sep_conv_3x3",
+    "dil_conv_3x3",
+)
+
+
+def _op(name: str, channels: int, stride: int):
+    """Primitive factory (reference ``operations.py`` OPS dict)."""
+
+    class Zero(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            if stride > 1:
+                x = x[:, ::stride, ::stride, :]
+            return jnp.zeros_like(x[..., :channels]) if (
+                x.shape[-1] != channels
+            ) else jnp.zeros_like(x)
+
+    class Skip(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            if stride == 1 and x.shape[-1] == channels:
+                return x
+            # factorized reduce (reference FactorizedReduce)
+            h = nn.Conv(channels, (1, 1), strides=(stride, stride),
+                        use_bias=False)(x)
+            return nn.BatchNorm(use_running_average=not train)(h)
+
+    class Pool(nn.Module):
+        kind: str
+
+        @nn.compact
+        def __call__(self, x, train=False):
+            window = (1, 3, 3, 1)
+            strides = (1, stride, stride, 1)
+            if self.kind == "avg":
+                h = jax.lax.reduce_window(
+                    x, 0.0, jax.lax.add, window, strides, "SAME"
+                ) / 9.0
+            else:
+                h = jax.lax.reduce_window(
+                    x, -jnp.inf, jax.lax.max, window, strides, "SAME"
+                )
+            if h.shape[-1] != channels:
+                h = nn.Conv(channels, (1, 1), use_bias=False)(h)
+            return h
+
+    class SepConv(nn.Module):
+        dilation: int = 1
+
+        @nn.compact
+        def __call__(self, x, train=False):
+            h = nn.relu(x)
+            h = nn.Conv(
+                x.shape[-1], (3, 3), strides=(stride, stride),
+                padding="SAME", feature_group_count=x.shape[-1],
+                kernel_dilation=(self.dilation, self.dilation),
+                use_bias=False,
+            )(h)
+            h = nn.Conv(channels, (1, 1), use_bias=False)(h)
+            return nn.BatchNorm(use_running_average=not train)(h)
+
+    return {
+        "none": Zero,
+        "skip_connect": Skip,
+        "avg_pool_3x3": lambda: Pool(kind="avg"),
+        "max_pool_3x3": lambda: Pool(kind="max"),
+        "sep_conv_3x3": SepConv,
+        "dil_conv_3x3": lambda: SepConv(dilation=2),
+    }[name]()
+
+
+class MixedOp(nn.Module):
+    """softmax(alpha)-weighted sum over all primitives
+    (reference ``model_search.py:34-50``)."""
+
+    channels: int
+    stride: int
+
+    @nn.compact
+    def __call__(self, x, weights, train: bool = False):
+        outs = [
+            _op(p, self.channels, self.stride)(x, train=train)
+            for p in PRIMITIVES
+        ]
+        stacked = jnp.stack(outs, axis=0)  # [P, B, H, W, C]
+        return jnp.einsum("p,pbhwc->bhwc", weights, stacked)
+
+
+class SearchCell(nn.Module):
+    """DARTS cell: ``steps`` intermediate nodes, each summing mixed ops
+    from all previous states (reference ``model_search.py:52-95``)."""
+
+    channels: int
+    steps: int = 4
+    reduction: bool = False
+
+    @nn.compact
+    def __call__(self, s0, s1, weights, train: bool = False):
+        # when the previous cell reduced, s0 (two cells back) is 2x the
+        # spatial size of s1 — align first (reference FactorizedReduce,
+        # operations.py)
+        if s0.shape[1] != s1.shape[1]:
+            s0 = s0[:, ::2, ::2, :]
+        s0 = nn.Conv(self.channels, (1, 1), use_bias=False)(s0)
+        s1 = nn.Conv(self.channels, (1, 1), use_bias=False)(s1)
+        if self.reduction:
+            s0 = s0[:, ::2, ::2, :]
+            s1 = s1[:, ::2, ::2, :]
+        states = [s0, s1]
+        offset = 0
+        for i in range(self.steps):
+            acc = 0.0
+            for j, h in enumerate(states):
+                acc = acc + MixedOp(self.channels, 1)(
+                    h, weights[offset + j], train=train
+                )
+            offset += len(states)
+            states.append(acc)
+        return jnp.concatenate(states[-self.steps:], axis=-1)
+
+
+def num_edges(steps: int) -> int:
+    return sum(2 + i for i in range(steps))
+
+
+class DARTSNetwork(nn.Module):
+    """Searchable network (reference ``model_search.py:172``): stem ->
+    [normal x N, reduction] cells -> classifier. Alphas: collection
+    ``arch`` with ``alphas_normal`` / ``alphas_reduce``
+    [num_edges, |PRIMITIVES|]."""
+
+    num_classes: int = 10
+    init_channels: int = 16
+    layers: int = 4
+    steps: int = 4
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        e = num_edges(self.steps)
+        a_n = self.param_or_arch("alphas_normal", e)
+        a_r = self.param_or_arch("alphas_reduce", e)
+        w_n = jax.nn.softmax(a_n, axis=-1)
+        w_r = jax.nn.softmax(a_r, axis=-1)
+
+        c = self.init_channels
+        h = nn.Conv(c, (3, 3), padding="SAME", use_bias=False)(x)
+        h = nn.BatchNorm(use_running_average=not train)(h)
+        s0 = s1 = h
+        for layer in range(self.layers):
+            reduction = layer in (self.layers // 3, 2 * self.layers // 3)
+            if reduction:
+                c *= 2
+            out = SearchCell(c, self.steps, reduction)(
+                s0, s1, w_r if reduction else w_n, train=train
+            )
+            s0, s1 = s1, out
+        h = jnp.mean(s1, axis=(1, 2))
+        return nn.Dense(self.num_classes)(h)
+
+    def param_or_arch(self, name: str, e: int):
+        return self.variable(
+            "arch", name,
+            lambda: 1e-3 * jax.random.normal(
+                self.make_rng("params"), (e, len(PRIMITIVES))
+            ),
+        ).value
+
+
+def derive_genotype(arch_vars) -> dict:
+    """argmax-derivation of the discrete architecture (reference
+    ``model_search.py`` ``genotype()``): for each node keep the two
+    strongest incoming edges with their best non-'none' op."""
+    out = {}
+    for key in ("alphas_normal", "alphas_reduce"):
+        alphas = jax.nn.softmax(arch_vars["arch"][key], axis=-1)
+        alphas = jax.device_get(alphas)
+        gene = []
+        offset = 0
+        none_idx = PRIMITIVES.index("none")
+        steps = 0
+        n_in = 2
+        e = alphas.shape[0]
+        # recover steps from edge count
+        while num_edges(steps) < e:
+            steps += 1
+        for i in range(steps):
+            k = 2 + i
+            rows = alphas[offset:offset + k]
+            best_op = rows.copy()
+            best_op[:, none_idx] = -1
+            edge_strength = best_op.max(axis=-1)
+            top2 = edge_strength.argsort()[-2:][::-1]
+            for j in sorted(top2):
+                op = int(best_op[j].argmax())
+                gene.append((PRIMITIVES[op], int(j)))
+            offset += k
+        out[key] = gene
+    return out

@@ -1,0 +1,179 @@
+"""Model zoo extensions: MobileNetV3, EfficientNet, LeNet.
+
+References: ``fedml_api/model/cv/mobilenet_v3.py:137`` (MobileNetV3 with
+SE + h-swish bottlenecks), ``fedml_api/model/cv/efficientnet.py:138``
+(EfficientNet with MBConv blocks, ``:36``, and compound width/depth
+scaling), ``fedml_api/model/mobile/lenet.py`` (the mobile LeNet used by the
+MNN converter path).
+
+TPU notes: NHWC; squeeze-excite is two tiny dense layers around a global
+mean — XLA fuses it into the surrounding elementwise ops; h-swish is
+``x * relu6(x + 3) / 6`` which lowers to a fused multiply.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def hswish(x):
+    return x * nn.relu6(x + 3.0) / 6.0
+
+
+def hsigmoid(x):
+    return nn.relu6(x + 3.0) / 6.0
+
+
+class SqueezeExcite(nn.Module):
+    reduce: int = 4
+
+    @nn.compact
+    def __call__(self, x):
+        c = x.shape[-1]
+        s = jnp.mean(x, axis=(1, 2))
+        s = nn.relu(nn.Dense(max(c // self.reduce, 8))(s))
+        s = hsigmoid(nn.Dense(c)(s))
+        return x * s[:, None, None, :]
+
+
+class MBConv(nn.Module):
+    """Mobile inverted bottleneck (EfficientNet ``MBConvBlock``,
+    ``efficientnet.py:36``; also the V3 bottleneck with SE)."""
+
+    out_channels: int
+    expand: int = 4
+    kernel: int = 3
+    stride: int = 1
+    use_se: bool = True
+    act: str = "swish"  # "swish" (EfficientNet) | "hswish" | "relu" (V3)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        act = {"swish": nn.swish, "hswish": hswish, "relu": nn.relu}[self.act]
+        cin = x.shape[-1]
+        h = x
+        mid = cin * self.expand
+        if self.expand != 1:
+            h = nn.Conv(mid, (1, 1), use_bias=False)(h)
+            h = nn.BatchNorm(use_running_average=not train)(h)
+            h = act(h)
+        h = nn.Conv(
+            mid, (self.kernel, self.kernel),
+            strides=(self.stride, self.stride), padding="SAME",
+            feature_group_count=mid, use_bias=False,
+        )(h)
+        h = nn.BatchNorm(use_running_average=not train)(h)
+        h = act(h)
+        if self.use_se:
+            h = SqueezeExcite()(h)
+        h = nn.Conv(self.out_channels, (1, 1), use_bias=False)(h)
+        h = nn.BatchNorm(use_running_average=not train)(h)
+        if self.stride == 1 and cin == self.out_channels:
+            h = h + x
+        return h
+
+
+class MobileNetV3(nn.Module):
+    """MobileNetV3-small-style network (reference ``mobilenet_v3.py:137``;
+    the full large config is a matter of the ``blocks`` table)."""
+
+    num_classes: int = 10
+    width_mult: float = 1.0
+    # (out, expand, kernel, stride, use_se, act)
+    blocks: Sequence[tuple] = (
+        (16, 1, 3, 2, True, "relu"),
+        (24, 4, 3, 2, False, "relu"),
+        (24, 3, 3, 1, False, "relu"),
+        (40, 3, 5, 2, True, "hswish"),
+        (40, 3, 5, 1, True, "hswish"),
+        (48, 3, 5, 1, True, "hswish"),
+        (96, 6, 5, 2, True, "hswish"),
+    )
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        def c(ch):
+            return max(8, int(ch * self.width_mult))
+
+        h = nn.Conv(c(16), (3, 3), strides=(2, 2), padding="SAME",
+                    use_bias=False)(x)
+        h = nn.BatchNorm(use_running_average=not train)(h)
+        h = hswish(h)
+        for out, exp, k, s, se, act in self.blocks:
+            h = MBConv(c(out), exp, k, s, se, act)(h, train=train)
+        h = nn.Conv(c(288), (1, 1), use_bias=False)(h)
+        h = nn.BatchNorm(use_running_average=not train)(h)
+        h = hswish(h)
+        h = jnp.mean(h, axis=(1, 2))
+        h = hswish(nn.Dense(c(1024))(h))
+        return nn.Dense(self.num_classes)(h)
+
+
+class EfficientNet(nn.Module):
+    """EfficientNet-B<k> via compound scaling (reference
+    ``efficientnet.py:138`` + ``efficientnet_utils.py`` round_filters /
+    round_repeats)."""
+
+    num_classes: int = 10
+    width_coef: float = 1.0
+    depth_coef: float = 1.0
+    # B0 stage table: (out, expand, kernel, stride, repeats)
+    stages: Sequence[tuple] = (
+        (16, 1, 3, 1, 1),
+        (24, 6, 3, 2, 2),
+        (40, 6, 5, 2, 2),
+        (80, 6, 3, 2, 3),
+        (112, 6, 5, 1, 3),
+        (192, 6, 5, 2, 4),
+        (320, 6, 3, 1, 1),
+    )
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        def width(ch):
+            ch = ch * self.width_coef
+            new = max(8, int(ch + 4) // 8 * 8)
+            if new < 0.9 * ch:
+                new += 8
+            return int(new)
+
+        def depth(r):
+            return int(math.ceil(r * self.depth_coef))
+
+        h = nn.Conv(width(32), (3, 3), strides=(2, 2), padding="SAME",
+                    use_bias=False)(x)
+        h = nn.BatchNorm(use_running_average=not train)(h)
+        h = nn.swish(h)
+        for out, exp, k, s, reps in self.stages:
+            for r in range(depth(reps)):
+                h = MBConv(
+                    width(out), exp, k, s if r == 0 else 1, True, "swish"
+                )(h, train=train)
+        h = nn.Conv(width(1280), (1, 1), use_bias=False)(h)
+        h = nn.BatchNorm(use_running_average=not train)(h)
+        h = nn.swish(h)
+        h = jnp.mean(h, axis=(1, 2))
+        return nn.Dense(self.num_classes)(h)
+
+
+class LeNet(nn.Module):
+    """Mobile LeNet (reference ``fedml_api/model/mobile/lenet.py`` — the
+    architecture shipped to the MNN mobile runtime)."""
+
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = nn.Conv(20, (5, 5))(x)
+        h = nn.max_pool(h, (2, 2), strides=(2, 2))
+        h = nn.relu(h)
+        h = nn.Conv(50, (5, 5))(h)
+        h = nn.max_pool(h, (2, 2), strides=(2, 2))
+        h = nn.relu(h)
+        h = h.reshape((h.shape[0], -1))
+        h = nn.relu(nn.Dense(500)(h))
+        return nn.Dense(self.num_classes)(h)

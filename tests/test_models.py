@@ -16,6 +16,10 @@ IMG_CASES = [
     ("resnet18_gn", (32, 32, 3), 100),
     ("mobilenet", (32, 32, 3), 10),
     ("vgg11", (32, 32, 3), 10),
+    ("mobilenet_v3", (32, 32, 3), 10),
+    ("efficientnet-b0", (32, 32, 3), 10),
+    ("lenet", (32, 32, 3), 10),
+    ("cnn_custom", (28, 28, 1), 10),
 ]
 
 
