@@ -199,7 +199,31 @@ class FedNASSim:
     def run_round(self, state: FedNASState):
         return self._round_fn(state, self.arrays)
 
-    def evaluate(self, state: FedNASState) -> dict:
+    def evaluate(self, state: FedNASState, eval_batch: int = 64) -> dict:
+        """Batched jitted eval — the supernet materializes a
+        [|ops|, B, H, W, C] stack per edge, so the whole test set in one
+        apply would OOM at CIFAR scale."""
         x, y = self.arrays.test_x, self.arrays.test_y
-        logits = self.model.apply(state.variables, x, train=False)
-        return {"test_acc": float(jnp.mean(jnp.argmax(logits, -1) == y))}
+        n = x.shape[0]
+        pad = (-n) % eval_batch
+        xp = jnp.concatenate([x, jnp.zeros((pad,) + x.shape[1:], x.dtype)])
+        yp = jnp.concatenate([y, jnp.zeros((pad,), y.dtype)])
+        w = jnp.concatenate([jnp.ones((n,)), jnp.zeros((pad,))])
+
+        @jax.jit
+        def run(variables):
+            def body(acc, i):
+                sl = lambda a: jax.lax.dynamic_slice_in_dim(
+                    a, i * eval_batch, eval_batch
+                )
+                logits = self.model.apply(variables, sl(xp), train=False)
+                hit = (jnp.argmax(logits, -1) == sl(yp)).astype(jnp.float32)
+                return acc + jnp.sum(hit * sl(w)), None
+
+            acc, _ = jax.lax.scan(
+                body, jnp.asarray(0.0),
+                jnp.arange((n + pad) // eval_batch),
+            )
+            return acc
+
+        return {"test_acc": float(run(state.variables)) / max(n, 1)}

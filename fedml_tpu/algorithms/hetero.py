@@ -220,6 +220,11 @@ class HeteroFedGDKD:
             jax.random.fold_in(self.root_key, 0x6E4)
         )
         self.round = 0
+        # drift-correction state (reference fedgdkd/server.py:92-97): last
+        # round's distillation set + cohort-mean teacher + membership
+        self._prev_synth: tuple | None = None
+        self._prev_teacher: np.ndarray | None = None
+        self._prev_sampled: set[int] = set()
 
     def run_round(self) -> dict:
         cfg = self.cfg.fed
@@ -229,6 +234,43 @@ class HeteroFedGDKD:
         )
         rkey = jax.random.fold_in(self.root_key, self.round)
         per_bucket = bucket_cohorts(self.buckets, cohort, self.pad_to)
+
+        # --- drift correction for new joiners (server.py:92-97): KD
+        #     against last round's distillation set + mean teacher ---
+        if self._prev_teacher is not None:
+            px, py = self._prev_synth
+            teacher_full = jnp.broadcast_to(
+                jnp.asarray(self._prev_teacher)[None],
+                (self.pad_to,) + self._prev_teacher.shape,
+            )
+            for bi, (b, (members, valid)) in enumerate(
+                zip(self.buckets, per_bucket)
+            ):
+                gids = b.client_ids[members]
+                is_new = np.array(
+                    [
+                        v > 0 and int(g) not in self._prev_sampled
+                        for g, v in zip(gids, valid)
+                    ]
+                )
+                if not is_new.any():
+                    continue
+                cls_vars = jax.tree.map(lambda s: s[members], b.stack)
+                ckeys = jax.vmap(
+                    lambda c: jax.random.fold_in(
+                        jax.random.fold_in(rkey, 0xD1F7), c
+                    )
+                )(jnp.asarray(gids))
+                corrected, _ = self._kd[bi](
+                    cls_vars, px, py, teacher_full, ckeys
+                )
+                upd = members[is_new]
+                b.stack = jax.tree.map(
+                    lambda s, n: s.at[jnp.asarray(upd)].set(
+                        n[jnp.asarray(is_new)]
+                    ),
+                    b.stack, corrected,
+                )
 
         # --- GAN phase per bucket ---
         gen_sums = None
@@ -267,7 +309,7 @@ class HeteroFedGDKD:
         )
 
         # --- cohort-wide logits -> leave-one-out teachers ---
-        logits_chunks, owners = [], []
+        logits_chunks = []
         for bi, entry in enumerate(new_cls):
             if entry is None:
                 continue
@@ -275,7 +317,6 @@ class HeteroFedGDKD:
             lg = self._extract[bi](cls_vars, synth_x)  # [pad_to, S, K]
             k = int(valid.sum())
             logits_chunks.append(np.asarray(lg[:k]))
-            owners.extend((bi, m) for m in range(k))
         logits = np.concatenate(logits_chunks, axis=0)  # [C, S, K]
         c = logits.shape[0]
         loo = (logits.sum(0)[None] - logits) / max(c - 1, 1)
@@ -310,6 +351,11 @@ class HeteroFedGDKD:
                 b.stack,
                 cls_vars,
             )
+
+        # record drift-correction state for the next round
+        self._prev_synth = (synth_x, synth_y)
+        self._prev_teacher = logits.mean(axis=0)  # [S, K]
+        self._prev_sampled = set(int(c) for c in cohort)
 
         self.round += 1
         return {"cohort": cohort.tolist(), "num_buckets": len(self.buckets)}

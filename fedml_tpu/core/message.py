@@ -14,10 +14,22 @@ from __future__ import annotations
 
 import dataclasses
 import pickle
+import struct
 from typing import Any
 
 import jax
 import numpy as np
+
+_WIRE_MAGIC = b"FMG1"
+_HDR = struct.Struct("<Q")
+
+
+@dataclasses.dataclass(frozen=True)
+class _TensorRef:
+    """Placeholder for a tensor lifted out of a pickled payload into the
+    native tensor frame."""
+
+    idx: int
 
 
 # Well-known message types (reference message_define.py files use small int
@@ -57,10 +69,57 @@ class Message:
         return Message(self.msg_type, self.sender, self.receiver, payload)
 
     def encode(self) -> bytes:
-        return pickle.dumps(self.host_copy(), protocol=5)
+        """Wire format: bulk tensors ride the native C++ tensor-frame codec
+        (:mod:`fedml_tpu.native.codec` — multithreaded gather memcpy, CRC);
+        everything else (structure + scalars) is pickled. Replaces the
+        reference's whole-payload pickle (``mpi_send_thread.py:22-27``).
+        """
+        from fedml_tpu.native.codec import TensorCodec
+
+        host = self.host_copy()
+        arrays: list[np.ndarray] = []
+
+        from fedml_tpu.native.codec import codec_supports
+
+        def strip(v):
+            if (
+                isinstance(v, np.ndarray)
+                and v.nbytes >= 256
+                and codec_supports(v.dtype)
+            ):
+                arrays.append(v)
+                return _TensorRef(len(arrays) - 1)
+            return v  # small / exotic-dtype values ride the pickle side
+
+        payload = jax.tree.map(strip, host.payload)
+        meta = pickle.dumps(
+            Message(self.msg_type, self.sender, self.receiver, payload),
+            protocol=5,
+        )
+        frame = TensorCodec().pack(arrays) if arrays else b""
+        return _WIRE_MAGIC + _HDR.pack(len(meta)) + meta + frame
 
     @staticmethod
     def decode(data: bytes) -> "Message":
-        msg = pickle.loads(data)
+        if not data.startswith(_WIRE_MAGIC):  # legacy plain-pickle frame
+            msg = pickle.loads(data)
+            assert isinstance(msg, Message)
+            return msg
+        off = len(_WIRE_MAGIC)
+        (meta_len,) = _HDR.unpack_from(data, off)
+        off += _HDR.size
+        msg = pickle.loads(data[off:off + meta_len])
         assert isinstance(msg, Message)
+        frame = data[off + meta_len:]
+        if frame:
+            from fedml_tpu.native.codec import TensorCodec
+
+            # copy: consumers own (writable) arrays that don't pin the
+            # whole wire frame alive, matching the old pickle semantics
+            arrays = [a.copy() for a in TensorCodec().unpack(frame)]
+            msg.payload = jax.tree.map(
+                lambda v: arrays[v.idx] if isinstance(v, _TensorRef) else v,
+                msg.payload,
+                is_leaf=lambda v: isinstance(v, _TensorRef),
+            )
         return msg

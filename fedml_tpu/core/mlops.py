@@ -1,0 +1,121 @@
+"""MLOps status reporting + system telemetry.
+
+Reference: ``fedml_core/mlops_logger.py:15-117`` (singleton publishing
+run/client status, training metrics, and system telemetry JSON to fixed
+MQTT topics ``fl_client/mlops/...`` / ``fl_server/mlops/...``) and
+``fedavg_cross_silo/SysStats.py:13`` (psutil + pynvml sampling).
+
+TPU-native shape: the logger writes the same topic->payload records to any
+sink — a transport (for a live MQTT-like control plane), a JSONL file, or
+an in-memory list for tests. ``SysStats`` samples psutil host metrics plus
+jax device memory stats (the TPU analog of pynvml GPU telemetry).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Callable
+
+TOPIC_CLIENT_STATUS = "fl_client/mlops/status"
+TOPIC_SERVER_STATUS = "fl_server/mlops/status"
+TOPIC_TRAINING_PROGRESS = "fl_server/mlops/training_progress_and_eval"
+TOPIC_SYSTEM = "fl_client/mlops/system_performance"
+
+
+class MLOpsLogger:
+    """Publishes status/metric records (reference ``MLOpsLogger``; the
+    singleton pattern is dropped — pass one instance around instead)."""
+
+    def __init__(self, sink: Callable[[str, dict], None] | None = None,
+                 jsonl_path: str | None = None):
+        self.records: list[tuple[str, dict]] = []
+        self._sink = sink
+        self._jsonl = None
+        if jsonl_path:
+            os.makedirs(os.path.dirname(jsonl_path) or ".", exist_ok=True)
+            self._jsonl = open(jsonl_path, "a")
+        self.run_id: str | None = None
+        self.edge_id: int | None = None
+
+    def set_context(self, run_id: str, edge_id: int = 0):
+        self.run_id = run_id
+        self.edge_id = edge_id
+
+    def _publish(self, topic: str, payload: dict):
+        payload = {
+            **payload,
+            "run_id": self.run_id,
+            "edge_id": self.edge_id,
+            "timestamp": time.time(),
+        }
+        self.records.append((topic, payload))
+        if self._sink is not None:
+            self._sink(topic, payload)
+        if self._jsonl is not None:
+            self._jsonl.write(json.dumps({"topic": topic, **payload}) + "\n")
+            self._jsonl.flush()
+
+    # -- reference API (mlops_logger.py:31-112) ----------------------------
+    def report_client_training_status(self, edge_id: int, status: str):
+        self._publish(
+            TOPIC_CLIENT_STATUS, {"edge_id": edge_id, "status": status}
+        )
+
+    def report_server_training_status(self, status: str):
+        self._publish(TOPIC_SERVER_STATUS, {"status": status})
+
+    def report_training_progress(self, round_idx: int, metrics: dict):
+        self._publish(
+            TOPIC_TRAINING_PROGRESS, {"round": round_idx, **metrics}
+        )
+
+    def report_system_metric(self, metric: dict | None = None):
+        self._publish(TOPIC_SYSTEM, metric or SysStats().sample())
+
+    def close(self):
+        if self._jsonl is not None:
+            self._jsonl.close()
+
+
+class SysStats:
+    """System telemetry sampler (reference ``SysStats.py:13``: psutil CPU /
+    memory / disk / network + pynvml GPU; here the accelerator side reads
+    jax device memory stats)."""
+
+    def __init__(self):
+        import psutil
+
+        self._ps = psutil
+        self._proc = psutil.Process()
+
+    def sample(self) -> dict[str, Any]:
+        ps = self._ps
+        vm = ps.virtual_memory()
+        disk = ps.disk_io_counters()
+        net = ps.net_io_counters()
+        out = {
+            "cpu_utilization": ps.cpu_percent(),
+            "process_cpu_threads_in_use": self._proc.num_threads(),
+            "process_memory_in_use": self._proc.memory_info().rss,
+            "process_memory_available": vm.available,
+            "system_memory_utilization": vm.percent,
+            "disk_utilization": (disk.read_bytes + disk.write_bytes)
+            if disk else 0,
+            "network_traffic": (net.bytes_sent + net.bytes_recv)
+            if net else 0,
+        }
+        try:
+            import jax
+
+            dev = jax.devices()[0]
+            stats = getattr(dev, "memory_stats", lambda: None)()
+            if stats:
+                out["device_memory_in_use"] = stats.get("bytes_in_use", 0)
+                out["device_memory_limit"] = stats.get(
+                    "bytes_limit", stats.get("bytes_reservable_limit", 0)
+                )
+        except Exception:  # noqa: BLE001 — telemetry must never crash a run
+            pass
+        return out

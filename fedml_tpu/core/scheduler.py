@@ -1,0 +1,96 @@
+"""Heterogeneous-workload scheduler (branch-and-bound).
+
+Re-design of the reference DP workload scheduler
+(``fedml_core/distributed/schedule/scheduler.py:3-177``,
+``DP_schedule(mode):109``): assign per-client workloads to heterogeneous
+resources, minimizing the makespan (max per-resource cost) subject to
+per-resource memory caps. The reference explores cases recursively by
+popping the current-cheapest partial map; here the same best-first search
+runs on a heap (no recursion-depth hazard, same expansion order), with the
+reference's two modes:
+
+- ``serial``: a resource runs its assigned workloads back-to-back; cost is
+  additive (``assign_a_workload_serial``).
+- ``parallel``: each resource has a concurrency budget; the reference's
+  parallel mode tracks per-resource occupancy (``assign_a_workload``).
+
+Workloads are pre-sorted descending (largest-first), matching
+``self.x = np.sort(workloads)[::-1]``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import itertools
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class Assignment:
+    """Result: ``mapping[i]`` = resource for workload i (original order),
+    ``costs[r]`` = total cost on resource r, ``makespan`` = max cost."""
+
+    mapping: np.ndarray
+    costs: np.ndarray
+    makespan: float
+
+
+class WorkloadScheduler:
+    def __init__(self, workloads, speeds, memory):
+        """``workloads``: per-client work (e.g. n_k x epochs);
+        ``speeds``: per-resource cost factor (reference ``constraints`` y);
+        ``memory``: per-resource cost cap."""
+        self.workloads = np.asarray(workloads, float)
+        self.order = np.argsort(self.workloads)[::-1]
+        self.sorted_w = self.workloads[self.order]
+        self.speeds = np.asarray(speeds, float)
+        self.memory = np.asarray(memory, float)
+
+    def schedule(self, mode: str = "serial") -> Assignment | None:
+        """Best-first branch-and-bound (reference ``DP_schedule``,
+        ``scheduler.py:109``). Returns None if no feasible assignment."""
+        assert mode in ("serial", "parallel")
+        n, r = len(self.sorted_w), len(self.speeds)
+        counter = itertools.count()
+        # heap entries: (makespan, tiebreak, next_workload_idx, costs, map)
+        heap = [(0.0, next(counter), 0, tuple(0.0 for _ in range(r)), ())]
+        while heap:
+            makespan, _, i, costs, mapping = heapq.heappop(heap)
+            if i == n:
+                full_map = np.empty(n, int)
+                full_map[self.order] = np.asarray(mapping, int)
+                return Assignment(
+                    mapping=full_map,
+                    costs=np.asarray(costs),
+                    makespan=makespan,
+                )
+            w = self.sorted_w[i]
+            for res in range(r):
+                cost = self.speeds[res] * w
+                new_costs = list(costs)
+                if mode == "serial":
+                    new_costs[res] += cost
+                else:
+                    # parallel: resource cost is its single largest job
+                    # (jobs run concurrently, bounded by memory)
+                    new_costs[res] = max(new_costs[res], cost)
+                if new_costs[res] > self.memory[res]:
+                    continue  # memory violation: prune (reference :47-50)
+                heapq.heappush(
+                    heap,
+                    (
+                        max(new_costs),
+                        next(counter),
+                        i + 1,
+                        tuple(new_costs),
+                        mapping + (res,),
+                    ),
+                )
+        return None
+
+
+def dp_schedule(workloads, speeds, memory, mode: str = "serial"):
+    """Functional entry mirroring the reference ``DP_schedule``."""
+    return WorkloadScheduler(workloads, speeds, memory).schedule(mode)

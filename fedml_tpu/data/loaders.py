@@ -420,7 +420,10 @@ def load_dataset(cfg: DataConfig) -> FederatedData:
                 f"sets supported: {sorted(shapes)})"
             )
         shape, nc2 = shapes[base]
-        return load_leaf_json(cfg.data_dir, nc2, x_shape=shape)
+        return load_leaf_json(
+            cfg.data_dir, nc2, x_shape=shape,
+            offline_hint="fake_femnist" if base == "femnist" else None,
+        )
     if name == "mnist":
         x_tr, y_tr, x_te, y_te, nc = load_mnist_arrays(cfg.data_dir)
     elif name in ("cifar10", "cifar100"):

@@ -99,14 +99,17 @@ def load_leaf_json(
     num_classes: int,
     task: str = "classification",
     x_shape: tuple | None = None,
+    offline_hint: str | None = None,
 ) -> FederatedData:
     """LEAF json splits (reference femnist/shakespeare download scripts):
-    ``train/*.json`` + ``test/*.json`` with users/user_data."""
+    ``train/*.json`` + ``test/*.json`` with users/user_data.
+    ``offline_hint`` names a fake dataset substitute for the error message
+    (only femnist has an offline stand-in)."""
 
     def read_split(split):
         out = {}
         d = os.path.join(data_dir, split)
-        _require(d, "fake_femnist")
+        _require(d, offline_hint)
         for fn in sorted(os.listdir(d)):
             if not fn.endswith(".json"):
                 continue
@@ -133,11 +136,16 @@ def load_leaf_json(
     )
 
 
-def _require(path: str, fake_name: str):
+def _require(path: str, fake_name: str | None):
     if not os.path.exists(path):
+        hint = (
+            f", or use dataset='{fake_name}' for offline runs"
+            if fake_name
+            else ""
+        )
         raise FileNotFoundError(
             f"{path} not found. Download it with the reference's data "
-            f"scripts, or use dataset='{fake_name}' for offline runs."
+            f"scripts{hint}."
         )
 
 
@@ -173,6 +181,8 @@ def make_backdoor_dataset(
     for c in attacker_clients:
         idx = data.train_idx_map[c]
         n_poison = int(len(idx) * poison_fraction)
+        if n_poison == 0:  # tiny client / small fraction: nothing to stamp
+            continue
         chosen = rng.choice(idx, n_poison, replace=False)
         x[chosen] = add_pixel_trigger(x[chosen], trigger_size)
         y[chosen] = target_label

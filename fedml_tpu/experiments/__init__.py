@@ -1,0 +1,7 @@
+"""Experiment harness (reference ``fedml_experiments``)."""
+
+from fedml_tpu.experiments.harness import (  # noqa: F401
+    ALGORITHMS,
+    Experiment,
+    build_sim,
+)

@@ -1,0 +1,104 @@
+"""CLI entry: ``python -m fedml_tpu.experiments.run ...``.
+
+Replaces the reference's per-algorithm ``main_<algo>.py`` argparse scripts
+(``fedml_experiments/{standalone,distributed}/*/main_*.py``) with one typed
+entry over the algorithm registry. Config precedence: ``--config`` JSON
+(the full :class:`ExperimentConfig` shape) overridden by explicit flags.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+
+from fedml_tpu.config import ExperimentConfig
+from fedml_tpu.experiments.harness import ALGORITHMS, Experiment
+
+
+def parse_args(argv=None) -> tuple[ExperimentConfig, int]:
+    p = argparse.ArgumentParser(
+        prog="fedml_tpu.experiments.run",
+        description="TPU-native federated learning experiment runner",
+    )
+    p.add_argument("--config", type=str, default=None,
+                   help="JSON file with the full ExperimentConfig")
+    p.add_argument("--algorithm", type=str, default=None,
+                   choices=sorted(ALGORITHMS))
+    p.add_argument("--dataset", type=str, default=None)
+    p.add_argument("--data_dir", type=str, default=None)
+    p.add_argument("--model", type=str, default=None)
+    p.add_argument("--num_classes", type=int, default=None)
+    p.add_argument("--input_shape", type=int, nargs="+", default=None)
+    p.add_argument("--client_num_in_total", type=int, default=None)
+    p.add_argument("--client_num_per_round", type=int, default=None)
+    p.add_argument("--comm_round", type=int, default=None)
+    p.add_argument("--epochs", type=int, default=None)
+    p.add_argument("--batch_size", type=int, default=None)
+    p.add_argument("--lr", type=float, default=None)
+    p.add_argument("--client_optimizer", type=str, default=None)
+    p.add_argument("--partition_method", type=str, default=None)
+    p.add_argument("--partition_alpha", type=float, default=None)
+    p.add_argument("--frequency_of_the_test", type=int, default=None)
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--repetitions", type=int, default=1)
+    p.add_argument("--run_name", type=str, default=None)
+    p.add_argument("--out_dir", type=str, default=None)
+    a = p.parse_args(argv)
+
+    if a.config:
+        with open(a.config) as f:
+            cfg = ExperimentConfig.from_dict(json.load(f))
+    else:
+        cfg = ExperimentConfig()
+
+    def rep(obj, **kw):
+        kw = {k: v for k, v in kw.items() if v is not None}
+        return dataclasses.replace(obj, **kw) if kw else obj
+
+    cfg = rep(
+        cfg,
+        data=rep(
+            cfg.data,
+            dataset=a.dataset,
+            data_dir=a.data_dir,
+            num_clients=a.client_num_in_total,
+            batch_size=a.batch_size,
+            partition_method=a.partition_method,
+            partition_alpha=a.partition_alpha,
+        ),
+        model=rep(
+            cfg.model,
+            name=a.model,
+            num_classes=a.num_classes,
+            input_shape=tuple(a.input_shape) if a.input_shape else None,
+        ),
+        train=rep(
+            cfg.train, lr=a.lr, epochs=a.epochs,
+            optimizer=a.client_optimizer,
+        ),
+        fed=rep(
+            cfg.fed,
+            algorithm=a.algorithm,
+            num_rounds=a.comm_round,
+            clients_per_round=a.client_num_per_round,
+            eval_every=a.frequency_of_the_test,
+        ),
+        seed=a.seed,
+        run_name=a.run_name,
+        out_dir=a.out_dir,
+    )
+    return cfg, a.repetitions
+
+
+def main(argv=None) -> int:
+    cfg, repetitions = parse_args(argv)
+    summaries = Experiment(cfg, repetitions).run()
+    for s in summaries:
+        print(json.dumps(s, default=float))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

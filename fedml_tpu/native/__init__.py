@@ -1,0 +1,7 @@
+"""Native (C++) runtime components, loaded via ctypes."""
+
+from fedml_tpu.native.codec import (  # noqa: F401
+    TensorCodec,
+    crc32,
+    native_available,
+)

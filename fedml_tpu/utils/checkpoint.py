@@ -1,0 +1,99 @@
+"""Round-state checkpoint / resume via orbax.
+
+The reference has NO framework-level checkpointing (SURVEY.md §5.4 — only
+algorithm-local ``torch.save`` in FedGKT/DARTS); this is the deliberate
+upgrade the survey calls out: any sim state (a pytree NamedTuple like
+``ServerState`` / ``FedGDKDState``) checkpoints atomically per round and a
+run resumes from the latest step after preemption.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+
+class RoundCheckpointer:
+    """Save/restore per-round sim state.
+
+    Usage::
+
+        ckpt = RoundCheckpointer(dir, keep=3)
+        state, start_round = ckpt.restore_or(state)   # resume if possible
+        for r in range(start_round, rounds):
+            state, _ = sim.run_round(state)
+            ckpt.save(r, state)
+    """
+
+    def __init__(self, directory: str, keep: int = 3):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=keep, create=True
+            ),
+        )
+
+    def save(self, round_idx: int, state: Any) -> None:
+        self._mgr.save(
+            round_idx, args=ocp.args.StandardSave(_to_savable(state))
+        )
+        self._mgr.wait_until_finished()
+
+    def latest_round(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def restore_or(self, init_state: Any) -> tuple[Any, int]:
+        """Return (state, next_round): the restored latest checkpoint if one
+        exists, else ``(init_state, 0)``."""
+        step = self._mgr.latest_step()
+        if step is None:
+            return init_state, 0
+        template = _to_savable(init_state)
+        restored = self._mgr.restore(
+            step, args=ocp.args.StandardRestore(template)
+        )
+        return _from_savable(init_state, restored), step + 1
+
+    def close(self):
+        self._mgr.close()
+
+
+def _to_savable(state: Any):
+    """NamedTuples -> plain nested dict of arrays (orbax-friendly)."""
+    if hasattr(state, "_asdict"):
+        return {k: _to_savable(v) for k, v in state._asdict().items()}
+    if isinstance(state, dict):
+        return {k: _to_savable(v) for k, v in state.items()}
+    if isinstance(state, (list, tuple)):
+        return {f"__{i}": _to_savable(v) for i, v in enumerate(state)}
+    return np.asarray(jax.device_get(state))
+
+
+def _from_savable(template: Any, blob: Any):
+    """Rebuild the original container types from the saved dict."""
+    if hasattr(template, "_asdict"):
+        return type(template)(
+            **{
+                k: _from_savable(v, blob[k])
+                for k, v in template._asdict().items()
+            }
+        )
+    if isinstance(template, dict):
+        return {k: _from_savable(v, blob[k]) for k, v in template.items()}
+    if isinstance(template, (list, tuple)):
+        vals = [
+            _from_savable(v, blob[f"__{i}"])
+            for i, v in enumerate(template)
+        ]
+        return type(template)(vals)
+    import jax.numpy as jnp
+
+    arr = jnp.asarray(blob)
+    tmpl = jnp.asarray(template)
+    return arr.astype(tmpl.dtype) if arr.dtype != tmpl.dtype else arr

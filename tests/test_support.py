@@ -1,0 +1,169 @@
+"""Support subsystems: FID, scheduler, MLOps logger, checkpointing, CLI."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+from fedml_tpu.core.mlops import MLOpsLogger, SysStats
+from fedml_tpu.core.scheduler import dp_schedule
+from fedml_tpu.metrics.fid import (
+    FIDScorer,
+    activation_statistics,
+    frechet_distance,
+)
+
+
+def test_frechet_distance_zero_for_identical():
+    rng = np.random.default_rng(0)
+    f = rng.normal(size=(200, 8))
+    mu, s = activation_statistics(f)
+    assert frechet_distance(mu, s, mu, s) < 1e-6
+
+
+def test_frechet_distance_orders_distributions():
+    rng = np.random.default_rng(0)
+    base = rng.normal(size=(300, 8))
+    near = base + rng.normal(scale=0.1, size=base.shape)
+    far = rng.normal(loc=3.0, size=(300, 8))
+    mu0, s0 = activation_statistics(base)
+    mu1, s1 = activation_statistics(near)
+    mu2, s2 = activation_statistics(far)
+    d_near = frechet_distance(mu0, s0, mu1, s1)
+    d_far = frechet_distance(mu0, s0, mu2, s2)
+    assert d_near < d_far
+
+
+def test_fid_scorer_end_to_end():
+    rng = np.random.default_rng(0)
+    real = rng.normal(size=(64, 16, 16, 1)).astype(np.float32)
+    fake_close = real + 0.05 * rng.normal(size=real.shape).astype(np.float32)
+    fake_far = rng.uniform(-1, 1, real.shape).astype(np.float32)
+    scorer = FIDScorer()
+    assert scorer.calculate_fid(real, fake_close) < scorer.calculate_fid(
+        real, fake_far
+    )
+
+
+def test_scheduler_serial_balances_makespan():
+    out = dp_schedule([10, 8, 6, 4, 2], speeds=[1.0, 1.0],
+                      memory=[100, 100], mode="serial")
+    assert out is not None
+    assert out.mapping.shape == (5,)
+    # optimal split: {10, 6} vs {8, 4, 2} -> makespan 16 (or symmetric)
+    assert out.makespan <= 16.0 + 1e-9
+    # cost bookkeeping consistent
+    for r in range(2):
+        expect = sum(
+            w for w, m in zip([10, 8, 6, 4, 2], out.mapping) if m == r
+        )
+        assert abs(out.costs[r] - expect) < 1e-9
+
+
+def test_scheduler_memory_infeasible():
+    assert dp_schedule([10], speeds=[1.0], memory=[5]) is None
+
+
+def test_scheduler_heterogeneous_speeds():
+    out = dp_schedule([4, 4], speeds=[1.0, 10.0], memory=[100, 100])
+    # everything should land on the fast resource (cost 8 < 40)
+    assert (out.mapping == 0).all()
+
+
+def test_mlops_logger_and_sysstats(tmp_path):
+    path = str(tmp_path / "mlops.jsonl")
+    log = MLOpsLogger(jsonl_path=path)
+    log.set_context("run1", edge_id=3)
+    log.report_client_training_status(3, "TRAINING")
+    log.report_training_progress(0, {"acc": 0.5})
+    stats = SysStats().sample()
+    assert "cpu_utilization" in stats
+    log.report_system_metric(stats)
+    log.close()
+    lines = [json.loads(l) for l in open(path)]
+    assert len(lines) == 3
+    assert lines[0]["status"] == "TRAINING"
+    assert lines[1]["round"] == 0
+
+
+def test_round_checkpointer_roundtrip(tmp_path):
+    import jax.numpy as jnp
+
+    from fedml_tpu.algorithms.fedavg import FedAvgSim, ServerState
+    from fedml_tpu.config import (
+        DataConfig, ExperimentConfig, FedConfig, ModelConfig, TrainConfig,
+    )
+    from fedml_tpu.data.loaders import load_dataset
+    from fedml_tpu.models import create_model
+    from fedml_tpu.utils.checkpoint import RoundCheckpointer
+
+    cfg = ExperimentConfig(
+        data=DataConfig(dataset="synthetic_1_1", num_clients=6,
+                        batch_size=16),
+        model=ModelConfig(name="lr", num_classes=10, input_shape=(60,)),
+        train=TrainConfig(lr=0.05, epochs=1),
+        fed=FedConfig(num_rounds=3, clients_per_round=3),
+        seed=0,
+    )
+    sim = FedAvgSim(create_model(cfg.model), load_dataset(cfg.data), cfg)
+    state = sim.init()
+    ckpt = RoundCheckpointer(str(tmp_path / "ckpt"))
+    restored, start = ckpt.restore_or(state)
+    assert start == 0
+    state, _ = sim.run_round(state)
+    ckpt.save(0, state)
+    state, _ = sim.run_round(state)
+    ckpt.save(1, state)
+    # resume: fresh init, restore -> equals round-2 state
+    state2, start2 = ckpt.restore_or(sim.init())
+    assert start2 == 2
+    for a, b in zip(
+        __import__("jax").tree.leaves(state.variables),
+        __import__("jax").tree.leaves(state2.variables),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    assert int(state2.round) == int(state.round)
+    ckpt.close()
+
+
+def test_experiment_harness_and_cli(tmp_path):
+    from fedml_tpu.config import (
+        DataConfig, ExperimentConfig, FedConfig, ModelConfig, TrainConfig,
+    )
+    from fedml_tpu.experiments import Experiment
+
+    cfg = ExperimentConfig(
+        data=DataConfig(dataset="synthetic_1_1", num_clients=6,
+                        batch_size=16),
+        model=ModelConfig(name="lr", num_classes=10, input_shape=(60,)),
+        train=TrainConfig(lr=0.05, epochs=1),
+        fed=FedConfig(algorithm="fedavg", num_rounds=2,
+                      clients_per_round=3, eval_every=2),
+        out_dir=str(tmp_path),
+        run_name="t",
+    )
+    summaries = Experiment(cfg, repetitions=2).run()
+    assert len(summaries) == 2
+    assert "train_loss" in summaries[0]
+    assert os.path.exists(tmp_path / "t_rep0" / "metrics.jsonl")
+    assert os.path.exists(tmp_path / "t_rep0" / "config.json")
+
+
+def test_cli_parse_args():
+    from fedml_tpu.experiments.run import parse_args
+
+    cfg, reps = parse_args([
+        "--algorithm", "fedavg", "--dataset", "synthetic_1_1",
+        "--model", "lr", "--num_classes", "10", "--input_shape", "60",
+        "--comm_round", "3", "--client_num_in_total", "5",
+        "--client_num_per_round", "2", "--lr", "0.1",
+        "--repetitions", "2",
+    ])
+    assert cfg.fed.algorithm == "fedavg"
+    assert cfg.fed.num_rounds == 3
+    assert cfg.data.num_clients == 5
+    assert cfg.model.input_shape == (60,)
+    assert cfg.train.lr == 0.1
+    assert reps == 2
