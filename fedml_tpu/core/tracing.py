@@ -1,0 +1,85 @@
+"""Tracing / profiling: structured timers and benchmark log lines.
+
+Reference: wall-clock timers around aggregation
+(``FedAVGAggregator.py:60,86-87``) and grep-able "--Benchmark" lines via
+``log_communication_tick/tock`` + ``log_round_start/end``
+(``fedml_core/distributed/communication/utils.py:4-18``). Here the same
+API feeds a structured in-memory trace (exportable to JSON) and optionally
+``jax.profiler`` ranges so device timelines line up with host spans.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import time
+from typing import Any
+
+
+class Tracer:
+    """Span collector with the reference's tick/tock vocabulary."""
+
+    def __init__(self, use_jax_profiler: bool = False):
+        self.events: list[dict[str, Any]] = []
+        self._open: dict[str, float] = {}
+        self._jax = use_jax_profiler
+
+    # -- reference-shaped API (communication/utils.py:4-18) ----------------
+    def log_communication_tick(self, sender, receiver, tag: str = ""):
+        self._open[f"comm:{sender}->{receiver}:{tag}"] = time.perf_counter()
+        logging.debug("--Benchmark tick comm %s->%s %s", sender, receiver, tag)
+
+    def log_communication_tock(self, sender, receiver, tag: str = ""):
+        key = f"comm:{sender}->{receiver}:{tag}"
+        t0 = self._open.pop(key, None)
+        if t0 is not None:
+            dt = time.perf_counter() - t0
+            self.events.append(
+                {"kind": "comm", "sender": sender, "receiver": receiver,
+                 "tag": tag, "seconds": dt}
+            )
+            logging.debug("--Benchmark tock comm %s %fs", key, dt)
+
+    def log_round_start(self, round_idx: int):
+        self._open[f"round:{round_idx}"] = time.perf_counter()
+
+    def log_round_end(self, round_idx: int):
+        t0 = self._open.pop(f"round:{round_idx}", None)
+        if t0 is not None:
+            self.events.append(
+                {"kind": "round", "round": round_idx,
+                 "seconds": time.perf_counter() - t0}
+            )
+
+    # -- generic spans -----------------------------------------------------
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs):
+        ctx = (
+            __import__("jax").profiler.TraceAnnotation(name)
+            if self._jax
+            else contextlib.nullcontext()
+        )
+        t0 = time.perf_counter()
+        with ctx:
+            yield
+        self.events.append(
+            {"kind": "span", "name": name,
+             "seconds": time.perf_counter() - t0, **attrs}
+        )
+
+    # -- reporting ---------------------------------------------------------
+    def summary(self) -> dict[str, dict]:
+        agg: dict[str, dict] = {}
+        for e in self.events:
+            key = e.get("name") or e["kind"]
+            s = agg.setdefault(key, {"count": 0, "total_s": 0.0})
+            s["count"] += 1
+            s["total_s"] += e["seconds"]
+        for s in agg.values():
+            s["mean_s"] = s["total_s"] / s["count"]
+        return agg
+
+    def dump(self, path: str):
+        with open(path, "w") as f:
+            json.dump(self.events, f, indent=2)

@@ -102,8 +102,16 @@ class FederatedData:
         }
 
     def to_arrays(
-        self, pad_multiple: int = 1, dtype=jnp.float32
+        self, pad_multiple: int = 1, dtype=None
     ) -> FederatedArrays:
+        if dtype is None:
+            # token datasets (NLP) must stay integer for nn.Embed; dense
+            # features go to float32
+            dtype = (
+                jnp.int32
+                if np.issubdtype(np.asarray(self.x_train).dtype, np.integer)
+                else jnp.float32
+            )
         idx, mask, counts = _pad_index_map(
             self.train_idx_map, self.num_clients, pad_multiple
         )

@@ -128,6 +128,20 @@ def create_model(cfg: ModelConfig) -> FedModel:
         )
     if name in ("tag_lr", "stackoverflow_lr"):
         return FedModel(TagLogisticRegression(nc), cfg.input_shape)
+    if name in ("transformer", "transformer_lm"):
+        from fedml_tpu.models.transformer import TransformerLM
+
+        return FedModel(
+            TransformerLM(
+                vocab_size=extra.get("vocab_size", 90),
+                num_layers=extra.get("num_layers", 2),
+                num_heads=extra.get("num_heads", 4),
+                embed_dim=extra.get("embed_dim", 128),
+                max_len=extra.get("max_len", 512),
+            ),
+            cfg.input_shape,
+            input_dtype=jnp.int32,
+        )
     if name in ("deeplab", "deeplab_lite"):  # fedseg (FedSegAPI.py:19)
         from fedml_tpu.models.segmentation import DeepLabLite
 

@@ -1,0 +1,3 @@
+"""TPU ops: pallas kernels + collective attention primitives."""
+
+from fedml_tpu.ops.ring_attention import ring_attention  # noqa: F401
