@@ -2,18 +2,20 @@
 
 Multi-chip hardware isn't available in CI; per the project conventions we
 validate all sharding logic on a virtual CPU mesh
-(``xla_force_host_platform_device_count``). The environment's sitecustomize
-registers the TPU backend and pins ``jax_platforms``, so we must override
-via ``jax.config.update`` (env vars alone are not enough).
+(``xla_force_host_platform_device_count``). The canonical provisioning
+recipe lives in ``__graft_entry__._provision_virtual_devices`` (the
+environment's sitecustomize registers the TPU backend and pins
+``jax_platforms``, so env vars alone are not enough).
 """
 
 import os
+import sys
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from __graft_entry__ import _provision_virtual_devices  # noqa: E402
+
+_provision_virtual_devices(8)
 
 import jax  # noqa: E402
 
