@@ -1,0 +1,58 @@
+#!/bin/sh
+# CI battery, mirroring the reference's shell-driven CI
+# (/root/reference/CI-script-fedavg.sh):
+#   1. fast pytest tier (unit + equivalence tests, no slow-compiling suites)
+#   2. tiny-run smoke matrix over dataset/model combos (CI-script-fedavg.sh:36-43)
+#   3. the convergence-equivalence oracle: full-batch FedAvg == centralized
+#      == hierarchical FL train accuracy to 3 decimals (CI-script-fedavg.sh:45-66)
+# Total budget: ~5 min on CPU.
+set -e
+cd "$(dirname "$0")"
+
+export JAX_PLATFORMS=cpu
+OUT=$(mktemp -d)
+
+echo "== 1/3 fast test tier =="
+python -m pytest tests -m "not slow" -q -x -p no:cacheprovider
+
+echo "== 2/3 smoke matrix (tiny runs) =="
+smoke() {
+  echo "  -- fedavg $1/$2"
+  python -m fedml_tpu.experiments.run \
+    --algorithm fedavg --dataset "$1" --model "$2" \
+    --client_num_in_total 4 --client_num_per_round 2 --comm_round 2 \
+    --epochs 1 --batch_size 16 --lr 0.03 --frequency_of_the_test 2 \
+    --num_classes "$3" --input_shape $4 --out_dir "$OUT/smoke" \
+    --run_name "smoke_$1_$2" > "$OUT/smoke_$1_$2.json"
+}
+smoke synthetic    lr       10 "60"
+smoke fake_mnist   lr       10 "28 28 1"
+smoke fake_mnist   cnn      10 "28 28 1"
+smoke fake_cifar10 resnet20 10 "32 32 3"
+smoke fake_shakespeare rnn  90 "80"
+smoke fake_stackoverflow_lr tag_lr 50 "1000"
+
+echo "== 3/3 convergence-equivalence oracle =="
+# full-batch (batch_size=-1) + epochs=1: FedAvg over all clients ==
+# centralized == single-group hierarchical, to 3 decimals (a mathematical
+# identity: full-batch gradient averaging == pooled gradient descent)
+oracle() {
+  python -m fedml_tpu.experiments.run \
+    --algorithm "$1" --dataset fake_mnist --model lr \
+    --client_num_in_total 8 --client_num_per_round 8 --comm_round 3 \
+    --epochs 1 --batch_size -1 --lr 0.1 --frequency_of_the_test 3 \
+    --num_classes 10 --input_shape 28 28 1 --partition_method homo \
+    --seed 7 --out_dir "$OUT/oracle" --run_name "oracle_$1" \
+    | python -c "import json,sys; print(json.loads(sys.stdin.readline())['train_acc'])"
+}
+A=$(oracle fedavg)
+B=$(oracle centralized)
+C=$(oracle hierarchical)
+python - "$A" "$B" "$C" <<'EOF'
+import sys
+a, b, c = (round(float(v), 3) for v in sys.argv[1:4])
+assert a == b == c, f"oracle mismatch: fedavg={a} centralized={b} hierarchical={c}"
+print(f"oracle ok: train_acc {a} == {b} == {c}")
+EOF
+
+echo "CI battery passed."

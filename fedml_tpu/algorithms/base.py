@@ -210,13 +210,53 @@ def build_local_update(
     steps_per_epoch = max_n // batch_size
     shard_bs = batch_size // data_axis_size
     opt = make_client_optimizer(cfg)
+    compute_dtype = jnp.dtype(cfg.compute_dtype)
+    mixed = compute_dtype != jnp.float32
+
+    def _to_compute(t):
+        """Cast float tensors to the compute dtype (mixed precision: master
+        params and optimizer state stay f32, the network runs in bf16 —
+        grads flow back through the cast as f32)."""
+        cast = lambda a: (
+            a.astype(compute_dtype)
+            if jnp.issubdtype(a.dtype, jnp.floating)
+            else a
+        )
+        return jax.tree.map(cast, t)
+
+    def _to_compute_vars(static_vars):
+        """batch_stats stay f32: the BN running-statistic EMA has relative
+        updates below bf16 resolution (momentum 0.99 -> 1% steps), so
+        quantizing the accumulator would freeze it. Flax computes the EMA in
+        the stats' own dtype — keeping the stored stats f32 keeps the
+        accumulation exact while activations run bf16."""
+        return {
+            k: (v if k == "batch_stats" else _to_compute(v))
+            for k, v in static_vars.items()
+        }
+
+    def _to_f32(t):
+        cast = lambda a: (
+            a.astype(jnp.float32) if a.dtype == compute_dtype else a
+        )
+        return jax.tree.map(cast, t)
 
     def loss_fn(params, static_vars, x_b, y_b, w_b, rng, global_params):
         """Weighted-SUM loss normalized by the psum-ed weight total, so that
         psum of per-shard grads equals the exact full-batch gradient even
         with masked (padded) samples."""
-        variables = {**static_vars, "params": params}
+        if mixed:
+            variables = {
+                **_to_compute_vars(static_vars),
+                "params": _to_compute(params),
+            }
+            x_b = _to_compute(x_b)
+        else:
+            variables = {**static_vars, "params": params}
         logits, new_vars = model.apply_train(variables, x_b, rng)
+        if mixed:
+            logits = logits.astype(jnp.float32)
+            new_vars = _to_f32(new_vars)
         sums = task.metric_sums(logits, y_b, w_b)
         w_total = sums["w_sum"]
         if data_axis is not None:
@@ -303,6 +343,7 @@ def build_local_update(
                 step_body,
                 (variables, opt_state, msums),
                 jnp.arange(steps_per_epoch),
+                unroll=min(cfg.scan_unroll, steps_per_epoch),
             )
             return (variables, opt_state, msums), None
 
@@ -311,9 +352,18 @@ def build_local_update(
         ekeys = jax.vmap(lambda e: jax.random.fold_in(rng, e))(
             jnp.arange(cfg.epochs)
         )
-        (variables, _, msums), _ = jax.lax.scan(
-            epoch_body, (global_vars, opt_state, msums0), ekeys
-        )
+        # A length-1 scan still emits a while loop with loop-carry layout
+        # copies; inline tiny epoch counts instead. Bounded at 2 so the
+        # program size cannot blow up as epochs x scan_unroll.
+        if cfg.epochs <= 2:
+            carry = (global_vars, opt_state, msums0)
+            for e in range(cfg.epochs):
+                carry, _ = epoch_body(carry, ekeys[e])
+            variables, _, msums = carry
+        else:
+            (variables, _, msums), _ = jax.lax.scan(
+                epoch_body, (global_vars, opt_state, msums0), ekeys
+            )
         n_k = jnp.sum(mask_row)
         return variables, n_k, msums
 

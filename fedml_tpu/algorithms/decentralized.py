@@ -28,7 +28,7 @@ import jax.numpy as jnp
 from fedml_tpu.config import ExperimentConfig
 from fedml_tpu.core import random as R
 from fedml_tpu.core.topology import SymmetricTopologyManager
-from fedml_tpu.data.federated import FederatedData
+from fedml_tpu.data.federated import FederatedData, arrays_and_batch
 from fedml_tpu.algorithms.base import (
     build_evaluator,
     build_local_update,
@@ -62,7 +62,7 @@ class DecentralizedSim:
         self.cfg = cfg
         self.method = method
         self.task = make_task(data.task)
-        self.arrays = data.to_arrays(pad_multiple=cfg.data.batch_size)
+        self.arrays, self.batch_size = arrays_and_batch(data, cfg.data)
         n = self.arrays.num_clients
         topology = topology or SymmetricTopologyManager(n, neighbor_num=2)
         self.W = jnp.asarray(topology.mixing_matrix(), jnp.float32)
@@ -72,7 +72,6 @@ class DecentralizedSim:
         # DSGD would leave w == ones and degenerate push-sum into DSGD.
         self.P = self.W / jnp.maximum(self.W.sum(axis=0, keepdims=True), 1e-12)
         max_n = self.arrays.max_client_samples
-        self.batch_size = min(cfg.data.batch_size, max_n)
         self.local_update = build_local_update(
             model, self.task, cfg.train, self.batch_size, max_n
         )

@@ -44,7 +44,7 @@ from fedml_tpu.algorithms.base import (
 from fedml_tpu.config import ExperimentConfig
 from fedml_tpu.core import random as R
 from fedml_tpu.core import tree as T
-from fedml_tpu.data.federated import FederatedArrays, FederatedData
+from fedml_tpu.data.federated import FederatedArrays, FederatedData, arrays_and_batch
 from fedml_tpu.models.base import FedModel
 
 Pytree = Any
@@ -169,10 +169,9 @@ class FedMDSim:
     ):
         self.model, self.cfg = model, cfg
         self.task = make_task(data.task)
-        pad = cfg.data.batch_size
-        self.arrays: FederatedArrays = data.to_arrays(pad_multiple=pad)
+        self.arrays, self.batch_size = arrays_and_batch(data, cfg.data)
         max_n = self.arrays.max_client_samples
-        self.batch_size = min(cfg.data.batch_size, max_n)
+
         px, py = build_public_set(
             data, cfg.gan.public_size, self.batch_size, cfg.data.seed
         )
@@ -323,10 +322,8 @@ class FDSim:
     ):
         self.model, self.cfg = model, cfg
         self.task = make_task(data.task)
-        pad = cfg.data.batch_size
-        self.arrays: FederatedArrays = data.to_arrays(pad_multiple=pad)
+        self.arrays, self.batch_size = arrays_and_batch(data, cfg.data)
         self.max_n = self.arrays.max_client_samples
-        self.batch_size = min(cfg.data.batch_size, self.max_n)
         self.num_classes = self.arrays.num_classes
         self.evaluator = build_evaluator(model, self.task)
         self.root_key = jax.random.key(cfg.seed)
@@ -506,10 +503,8 @@ class FedArjunSim:
     ):
         self.adapter, self.local, self.cfg = adapter, local, cfg
         self.task = make_task(data.task)
-        pad = cfg.data.batch_size
-        self.arrays: FederatedArrays = data.to_arrays(pad_multiple=pad)
+        self.arrays, self.batch_size = arrays_and_batch(data, cfg.data)
         self.max_n = self.arrays.max_client_samples
-        self.batch_size = min(cfg.data.batch_size, self.max_n)
         self.local_train = build_local_update(
             local, self.task, cfg.train, self.batch_size, self.max_n
         )

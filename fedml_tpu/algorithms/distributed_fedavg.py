@@ -33,7 +33,7 @@ from fedml_tpu.core.message import (
     Message,
 )
 from fedml_tpu.core.transport.base import BaseTransport
-from fedml_tpu.data.federated import FederatedData
+from fedml_tpu.data.federated import FederatedData, arrays_and_batch
 from fedml_tpu.algorithms.base import build_local_update, make_task
 from fedml_tpu.models.base import FedModel
 
@@ -130,9 +130,8 @@ class FedAvgClientActor(ClientManager):
         super().__init__(rank, size, transport)
         self.cfg = cfg
         self.model = model
-        self.arrays = data.to_arrays(pad_multiple=cfg.data.batch_size)
+        self.arrays, batch = arrays_and_batch(data, cfg.data)
         max_n = self.arrays.max_client_samples
-        batch = min(cfg.data.batch_size, max_n)
         task = make_task(data.task)
         self._local_update = jax.jit(
             build_local_update(model, task, cfg.train, batch, max_n)

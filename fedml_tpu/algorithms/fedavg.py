@@ -32,7 +32,7 @@ import optax
 from fedml_tpu.config import ExperimentConfig, FedConfig, TrainConfig
 from fedml_tpu.core import random as R
 from fedml_tpu.core import robust, tree as T
-from fedml_tpu.data.federated import FederatedArrays, FederatedData
+from fedml_tpu.data.federated import FederatedArrays, FederatedData, arrays_and_batch
 from fedml_tpu.algorithms.base import (
     build_evaluator,
     build_local_update,
@@ -193,12 +193,8 @@ class FedAvgSim:
         self.model = model
         self.cfg = cfg
         self.task = make_task(data.task)
-        pad = 1 if cfg.data.full_batch else cfg.data.batch_size
-        self.arrays: FederatedArrays = data.to_arrays(pad_multiple=pad)
+        self.arrays, self.batch_size = arrays_and_batch(data, cfg.data)
         max_n = self.arrays.max_client_samples
-        self.batch_size = max_n if cfg.data.full_batch else min(
-            cfg.data.batch_size, max_n
-        )
         self.steps_per_epoch = max_n // self.batch_size
         self.local_update = build_local_update(
             model, self.task, cfg.train, self.batch_size, max_n

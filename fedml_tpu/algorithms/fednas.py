@@ -25,7 +25,7 @@ from fedml_tpu.algorithms.base import make_client_optimizer
 from fedml_tpu.config import ExperimentConfig
 from fedml_tpu.core import random as R
 from fedml_tpu.core import tree as T
-from fedml_tpu.data.federated import FederatedArrays, FederatedData
+from fedml_tpu.data.federated import FederatedArrays, FederatedData, arrays_and_batch
 from fedml_tpu.models.darts import DARTSNetwork
 
 Pytree = Any
@@ -48,12 +48,11 @@ class FedNASSim:
     ):
         self.model = model
         self.cfg = cfg
-        pad = cfg.data.batch_size
-        self.arrays: FederatedArrays = data.to_arrays(pad_multiple=pad)
+        self.arrays, self._resolved_batch = arrays_and_batch(data, cfg.data)
         self.max_n = self.arrays.max_client_samples
         # the 50/50 train/val split for the architect needs at least one
         # batch per half — cap the batch size accordingly
-        self.batch_size = max(1, min(cfg.data.batch_size, self.max_n // 2))
+        self.batch_size = max(1, min(self._resolved_batch, self.max_n // 2))
         self.input_shape = self.arrays.x.shape[1:]
         self.w_opt = make_client_optimizer(cfg.train)
         self.a_opt = optax.adam(arch_lr)  # reference arch_lr adam

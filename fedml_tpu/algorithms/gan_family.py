@@ -39,7 +39,7 @@ from fedml_tpu.algorithms.base import (
 from fedml_tpu.config import ExperimentConfig
 from fedml_tpu.core import random as R
 from fedml_tpu.core import tree as T
-from fedml_tpu.data.federated import FederatedArrays, FederatedData
+from fedml_tpu.data.federated import FederatedArrays, FederatedData, arrays_and_batch
 from fedml_tpu.models.base import FedModel
 from fedml_tpu.models.gan import GanModel
 
@@ -72,10 +72,9 @@ class FedGANSim:
         cfg: ExperimentConfig,
     ):
         self.gen, self.disc, self.cfg = gen, disc, cfg
-        pad = cfg.data.batch_size
-        self.arrays: FederatedArrays = data.to_arrays(pad_multiple=pad)
+        self.arrays, self.batch_size = arrays_and_batch(data, cfg.data)
         max_n = self.arrays.max_client_samples
-        self.batch_size = min(cfg.data.batch_size, max_n)
+
         self.input_shape = self.arrays.x.shape[1:]
         self.local_update = G.build_gan_local_update(
             gen, disc, cfg.train, cfg.gan, self.batch_size, max_n,
@@ -168,10 +167,9 @@ class FedGDKDSim:
         self.gen, self.cfg = gen, cfg
         self.classifier = classifier
         self.disc = G.DiscHandle.from_fed_model(classifier)
-        pad = cfg.data.batch_size
-        self.arrays: FederatedArrays = data.to_arrays(pad_multiple=pad)
+        self.arrays, self.batch_size = arrays_and_batch(data, cfg.data)
         max_n = self.arrays.max_client_samples
-        self.batch_size = min(cfg.data.batch_size, max_n)
+
         self.input_shape = self.arrays.x.shape[1:]
         gan = cfg.gan
         self.synth_size = (
@@ -376,10 +374,8 @@ class FedDTGSim:
         self.gen, self.disc, self.cfg = gen, disc, cfg
         self.classifier = classifier
         self.cls_handle = G.DiscHandle.from_fed_model(classifier)
-        pad = cfg.data.batch_size
-        self.arrays: FederatedArrays = data.to_arrays(pad_multiple=pad)
+        self.arrays, self.batch_size = arrays_and_batch(data, cfg.data)
         self.max_n = self.arrays.max_client_samples
-        self.batch_size = min(cfg.data.batch_size, self.max_n)
         self.input_shape = self.arrays.x.shape[1:]
         self.synth_size = (
             cfg.gan.distillation_size // self.batch_size

@@ -32,7 +32,7 @@ from fedml_tpu.algorithms.base import build_evaluator, make_task
 from fedml_tpu.algorithms.stack_utils import vmap_init
 from fedml_tpu.config import ExperimentConfig, ModelConfig
 from fedml_tpu.core import tree as T
-from fedml_tpu.data.federated import FederatedArrays, FederatedData
+from fedml_tpu.data.federated import FederatedArrays, FederatedData, arrays_and_batch
 from fedml_tpu.models import create_model
 from fedml_tpu.models.base import FedModel
 from fedml_tpu.models.gan import GanModel
@@ -174,10 +174,8 @@ class HeteroFedGDKD:
     ):
         self.gen, self.cfg = gen, cfg
         self.task = make_task(data.task)
-        pad = cfg.data.batch_size
-        self.arrays: FederatedArrays = data.to_arrays(pad_multiple=pad)
+        self.arrays, self.batch_size = arrays_and_batch(data, cfg.data)
         self.max_n = self.arrays.max_client_samples
-        self.batch_size = min(cfg.data.batch_size, self.max_n)
         self.root_key = jax.random.key(cfg.seed)
         self.buckets = build_buckets(
             specs, self.root_key, self.arrays.num_clients

@@ -23,7 +23,7 @@ import numpy as np
 from fedml_tpu.config import ExperimentConfig
 from fedml_tpu.core import random as R
 from fedml_tpu.core import tree as T
-from fedml_tpu.data.federated import FederatedData
+from fedml_tpu.data.federated import FederatedData, arrays_and_batch
 from fedml_tpu.algorithms.base import (
     build_evaluator,
     build_local_update,
@@ -54,7 +54,7 @@ class HierarchicalFedAvg:
         self.model = model
         self.cfg = cfg
         self.task = make_task(data.task)
-        self.arrays = data.to_arrays(pad_multiple=cfg.data.batch_size)
+        self.arrays, self.batch_size = arrays_and_batch(data, cfg.data)
         n = self.arrays.num_clients
         assert n % num_groups == 0, (n, num_groups)
         self.num_groups = num_groups
@@ -66,7 +66,6 @@ class HierarchicalFedAvg:
             rng.permutation(n).reshape(num_groups, self.group_size)
         )
         max_n = self.arrays.max_client_samples
-        self.batch_size = min(cfg.data.batch_size, max_n)
         self.local_update = build_local_update(
             model, self.task, cfg.train, self.batch_size, max_n
         )

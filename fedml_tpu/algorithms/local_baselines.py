@@ -29,7 +29,7 @@ from fedml_tpu.algorithms.base import (
 )
 from fedml_tpu.algorithms.stack_utils import evaluate_stack, vmap_init
 from fedml_tpu.config import ExperimentConfig
-from fedml_tpu.data.federated import FederatedArrays, FederatedData
+from fedml_tpu.data.federated import FederatedArrays, FederatedData, arrays_and_batch
 
 Pytree = Any
 
@@ -45,11 +45,8 @@ class BaselineSim:
     def __init__(self, model, data: FederatedData, cfg: ExperimentConfig):
         self.model, self.cfg = model, cfg
         self.task = make_task(data.task)
-        self.arrays: FederatedArrays = data.to_arrays(
-            pad_multiple=cfg.data.batch_size
-        )
+        self.arrays, self.batch_size = arrays_and_batch(data, cfg.data)
         max_n = self.arrays.max_client_samples
-        self.batch_size = min(cfg.data.batch_size, max_n)
         self.local_update = build_local_update(
             model, self.task, cfg.train, self.batch_size, max_n
         )
@@ -117,12 +114,8 @@ class CentralizedTrainer:
         self.model, self.cfg = model, cfg
         pooled = pooled_data(data)
         self.task = make_task(pooled.task)
-        pad = 1 if cfg.data.full_batch else cfg.data.batch_size
-        self.arrays = pooled.to_arrays(pad_multiple=pad)
+        self.arrays, self.batch_size = arrays_and_batch(pooled, cfg.data)
         max_n = self.arrays.max_client_samples
-        self.batch_size = max_n if cfg.data.full_batch else min(
-            cfg.data.batch_size, max_n
-        )
         self.local_update = build_local_update(
             model, self.task, cfg.train, self.batch_size, max_n
         )

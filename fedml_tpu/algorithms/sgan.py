@@ -34,7 +34,7 @@ from fedml_tpu.config import ExperimentConfig
 from fedml_tpu.core import random as R
 from fedml_tpu.core import tree as T
 from fedml_tpu.algorithms.stack_utils import vmap_init
-from fedml_tpu.data.federated import FederatedArrays, FederatedData
+from fedml_tpu.data.federated import FederatedArrays, FederatedData, arrays_and_batch
 from fedml_tpu.models.gan import GanModel
 
 Pytree = Any
@@ -59,10 +59,8 @@ class FedSSGANSim:
         label_fraction: float = 0.5,
     ):
         self.gen, self.disc, self.cfg = gen, disc, cfg
-        pad = cfg.data.batch_size
-        self.arrays: FederatedArrays = data.to_arrays(pad_multiple=pad)
+        self.arrays, self.batch_size = arrays_and_batch(data, cfg.data)
         self.max_n = self.arrays.max_client_samples
-        self.batch_size = min(cfg.data.batch_size, self.max_n)
         self.input_shape = self.arrays.x.shape[1:]
         self.label_fraction = float(label_fraction)
         # per-sample labelled mask over the GLOBAL train array, seeded so
@@ -257,10 +255,8 @@ class FedUAGANSim:
     ):
         assert disc.has_validity_head, "UA-GAN needs an ACGAN discriminator"
         self.gen, self.disc, self.cfg = gen, disc, cfg
-        pad = cfg.data.batch_size
-        self.arrays: FederatedArrays = data.to_arrays(pad_multiple=pad)
+        self.arrays, self.batch_size = arrays_and_batch(data, cfg.data)
         self.max_n = self.arrays.max_client_samples
-        self.batch_size = min(cfg.data.batch_size, self.max_n)
         self.input_shape = self.arrays.x.shape[1:]
         self.g_opt = G.make_gen_optimizer(cfg.gan)
         self.root_key = jax.random.key(cfg.seed)

@@ -38,7 +38,7 @@ from fedml_tpu.algorithms.base import make_client_optimizer
 from fedml_tpu.algorithms.stack_utils import stack_gather, vmap_init
 from fedml_tpu.config import ExperimentConfig
 from fedml_tpu.core import random as R
-from fedml_tpu.data.federated import FederatedArrays, FederatedData
+from fedml_tpu.data.federated import FederatedArrays, FederatedData, arrays_and_batch
 
 Pytree = Any
 
@@ -88,10 +88,8 @@ class FedGKTSim:
         self.cfg = cfg
         self.T = float(temperature)
         self.alpha = float(alpha)
-        pad = cfg.data.batch_size
-        self.arrays: FederatedArrays = data.to_arrays(pad_multiple=pad)
+        self.arrays, self.batch_size = arrays_and_batch(data, cfg.data)
         self.max_n = self.arrays.max_client_samples
-        self.batch_size = min(cfg.data.batch_size, self.max_n)
         self.num_classes = self.arrays.num_classes
         self.input_shape = self.arrays.x.shape[1:]
         self.n_total = self.arrays.x.shape[0]
@@ -394,10 +392,8 @@ class SplitNNSim:
         self.client_model = client_model
         self.server_model = server_model
         self.cfg = cfg
-        pad = cfg.data.batch_size
-        self.arrays: FederatedArrays = data.to_arrays(pad_multiple=pad)
+        self.arrays, self.batch_size = arrays_and_batch(data, cfg.data)
         self.max_n = self.arrays.max_client_samples
-        self.batch_size = min(cfg.data.batch_size, self.max_n)
         self.input_shape = self.arrays.x.shape[1:]
         self.c_opt = make_client_optimizer(cfg.train)
         self.s_opt = make_client_optimizer(cfg.train)

@@ -132,6 +132,21 @@ class FederatedData:
         )
 
 
+def arrays_and_batch(data: "FederatedData", dcfg) -> tuple["FederatedArrays", int]:
+    """Resolve the (arrays, client batch size) pair from a DataConfig,
+    honoring full-batch mode (the reference's ``batch_size=-1`` →
+    ``combine_batches``, ``fedml_experiments/standalone/utils/dataset.py:158-164``).
+
+    Every simulator should use this instead of reading
+    ``dcfg.batch_size`` directly, so full-batch mode cannot be silently
+    ignored by an algorithm."""
+    pad = 1 if dcfg.full_batch else dcfg.batch_size
+    arrays = data.to_arrays(pad_multiple=pad)
+    max_n = arrays.max_client_samples
+    batch = max_n if dcfg.full_batch else min(dcfg.batch_size, max_n)
+    return arrays, batch
+
+
 def build_federated_data(
     x_train: np.ndarray,
     y_train: np.ndarray,

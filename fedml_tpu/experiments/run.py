@@ -64,7 +64,10 @@ def parse_args(argv=None) -> tuple[ExperimentConfig, int]:
             dataset=a.dataset,
             data_dir=a.data_dir,
             num_clients=a.client_num_in_total,
-            batch_size=a.batch_size,
+            # batch_size=-1 == the reference's full-batch `combine_batches`
+            # mode (fedml_experiments/standalone/utils/dataset.py:158-164)
+            batch_size=None if a.batch_size == -1 else a.batch_size,
+            full_batch=True if a.batch_size == -1 else None,
             partition_method=a.partition_method,
             partition_alpha=a.partition_alpha,
         ),

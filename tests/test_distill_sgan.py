@@ -1,5 +1,9 @@
 """FedMD / FD / FedArjun / FedSSGAN / FedUAGAN round-execution tests."""
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -167,3 +167,34 @@ def test_fedprox_runs():
     sim = FedAvgSim(create_model(cfg.model), data, cfg)
     state, m = sim.run_round(sim.init())
     assert np.isfinite(float(m["train_loss"]))
+
+
+def test_bf16_compute_path_close_to_f32():
+    """Mixed precision (TrainConfig.compute_dtype="bfloat16", the bench fast
+    path): params/optimizer stay f32, network runs bf16. The trajectory must
+    stay close to the f32 one over a few rounds, and scan_unroll must not
+    change results at all."""
+    states = {}
+    for name, train in {
+        "f32": TrainConfig(lr=0.1, epochs=1),
+        "f32_unroll": TrainConfig(lr=0.1, epochs=1, scan_unroll=8),
+        "bf16": TrainConfig(lr=0.1, epochs=1, compute_dtype="bfloat16"),
+    }.items():
+        cfg = small_cfg(
+            train=train,
+            fed=FedConfig(num_rounds=3, clients_per_round=4, eval_every=3),
+        )
+        data = load_dataset(cfg.data)
+        sim = FedAvgSim(create_model(cfg.model), data, cfg)
+        state = sim.init()
+        for _ in range(3):
+            state, _ = sim.run_round(state)
+        states[name] = state
+
+    leaves = lambda s: jax.tree.leaves(s.variables["params"])
+    for a, b in zip(leaves(states["f32"]), leaves(states["f32_unroll"])):
+        np.testing.assert_allclose(a, b, rtol=1e-6)  # unroll: exact
+    for a, b in zip(leaves(states["f32"]), leaves(states["bf16"])):
+        assert a.dtype == jnp.float32 and b.dtype == jnp.float32
+        # bf16 compute: same trajectory up to bf16 resolution
+        np.testing.assert_allclose(a, b, atol=0.05, rtol=0.1)

@@ -1,5 +1,9 @@
 """FedNAS / DARTS tests."""
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 import jax
 import jax.numpy as jnp
 import numpy as np

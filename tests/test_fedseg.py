@@ -1,5 +1,6 @@
 """FedSeg: FedAvg over a segmentation task + IoU metric suite."""
 
+import pytest
 import jax
 import numpy as np
 
@@ -43,6 +44,7 @@ def test_seg_evaluator_metrics():
     assert ev.mean_iou() < 1.0
 
 
+@pytest.mark.slow
 def test_fedseg_rounds_and_miou():
     cfg = ExperimentConfig(
         data=DataConfig(dataset="fake_seg", num_clients=4,

@@ -6,6 +6,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from fedml_tpu.algorithms import gan_core as GC
 from fedml_tpu.algorithms import kd as KD
 from fedml_tpu.algorithms.gan_family import (

@@ -1,6 +1,10 @@
 """Heterogeneous-model client tests: config parsing, bucketing, and a
 HeteroFedGDKD round with two distinct architectures."""
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 import numpy as np
 
 from fedml_tpu.algorithms.hetero import (

@@ -4,8 +4,11 @@ import jax
 import jax.numpy as jnp
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from fedml_tpu.config import ModelConfig
 from fedml_tpu.models import create_model
+
 
 IMG_CASES = [
     ("lr", (28, 28, 1), 10),

@@ -1,5 +1,9 @@
 """FedGKT / SplitNN / vertical FL tests."""
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 import jax
 import jax.numpy as jnp
 import numpy as np

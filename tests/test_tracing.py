@@ -1,5 +1,6 @@
 """Tracer + transformer-as-FedModel tests."""
 
+import pytest
 import jax
 import jax.numpy as jnp
 
@@ -22,6 +23,7 @@ def test_tracer_comm_and_rounds(tmp_path):
     assert (tmp_path / "trace.json").exists()
 
 
+@pytest.mark.slow
 def test_transformer_fedmodel_in_fedavg():
     """The transformer works as a federated NWP model end-to-end."""
     from fedml_tpu.algorithms.fedavg import FedAvgSim
