@@ -189,11 +189,17 @@ class FedAvgSim:
         model: FedModel,
         data: FederatedData,
         cfg: ExperimentConfig,
+        sampler=None,
     ):
+        # cohort sampler: (key, num_clients, clients_per_round) -> ids.
+        # Default = global uniform without replacement; the sharded runtime's
+        # equality tests pass R.sample_clients_stratified to mirror its
+        # per-shard sampling on one device.
+        self.sampler = sampler or R.sample_clients
         self.model = model
         self.cfg = cfg
         self.task = make_task(data.task)
-        self.arrays, self.batch_size = arrays_and_batch(data, cfg.data)
+        self._prepare_data(data, cfg)
         max_n = self.arrays.max_client_samples
         self.steps_per_epoch = max_n // self.batch_size
         self.local_update = build_local_update(
@@ -202,6 +208,12 @@ class FedAvgSim:
         self.evaluator = build_evaluator(model, self.task)
         self.root_key = jax.random.key(cfg.seed)
         self._round_fn = jax.jit(self._round, donate_argnums=(0,))
+
+    def _prepare_data(self, data: FederatedData, cfg: ExperimentConfig):
+        """Resolve device data + batch size. The mesh-sharded subclass
+        overrides this to keep the global arrays host-side (its training
+        data lives in per-shard banks instead)."""
+        self.arrays, self.batch_size = arrays_and_batch(data, cfg.data)
 
     # -- initialization ----------------------------------------------------
     def init(self) -> ServerState:
@@ -224,7 +236,7 @@ class FedAvgSim:
     def _round(self, state: ServerState, arrays: FederatedArrays):
         cfg = self.cfg.fed
         rkey = R.round_key(self.root_key, state.round)
-        cohort = R.sample_clients(
+        cohort = self.sampler(
             jax.random.fold_in(rkey, 0),
             arrays.num_clients,
             cfg.clients_per_round,

@@ -37,3 +37,38 @@ def sample_clients(
     return jax.random.choice(
         key, num_clients, shape=(clients_per_round,), replace=False
     ).astype(jnp.int32)
+
+
+def sample_stratum(
+    key: jax.Array, stratum, stratum_size: int, cohort_per_stratum: int
+) -> jax.Array:
+    """One stratum's slice of a stratified cohort: sample
+    ``cohort_per_stratum`` of the ``stratum_size`` clients owned by
+    ``stratum`` (clients ``[stratum*size, (stratum+1)*size)``), returning
+    LOCAL ids. Used by the mesh-sharded runtime where each ``clients``-axis
+    shard owns a fixed block of the population and its samples — the TPU
+    analog of the reference's data-stays-in-silo placement
+    (``fedavg_cross_silo/DistWorker.py:31-54``)."""
+    skey = jax.random.fold_in(key, stratum)
+    if cohort_per_stratum >= stratum_size:
+        return jnp.arange(stratum_size, dtype=jnp.int32)
+    return jax.random.choice(
+        skey, stratum_size, shape=(cohort_per_stratum,), replace=False
+    ).astype(jnp.int32)
+
+
+def sample_clients_stratified(
+    key: jax.Array, num_clients: int, clients_per_round: int, n_strata: int
+) -> jax.Array:
+    """Host-mirror of the sharded runtime's per-shard sampling: the global
+    cohort is the concatenation of each stratum's :func:`sample_stratum`
+    choice (as GLOBAL ids). A single-device simulator using this sampler
+    follows the exact same trajectory as :class:`ShardedFedAvg` — the basis
+    of the sharded-equality tests."""
+    assert num_clients % n_strata == 0
+    assert clients_per_round % n_strata == 0
+    size = num_clients // n_strata
+    per = clients_per_round // n_strata
+    return jnp.concatenate(
+        [sample_stratum(key, s, size, per) + s * size for s in range(n_strata)]
+    )

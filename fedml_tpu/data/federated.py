@@ -54,18 +54,40 @@ class FederatedArrays:
         return self.idx.shape[1]
 
 
+def _round_up(n: int, multiple: int) -> int:
+    n = max(1, n)
+    if multiple > 1:
+        n = ((n + multiple - 1) // multiple) * multiple
+    return n
+
+
+def _infer_input_dtype(x: np.ndarray):
+    """Token datasets (NLP) must stay integer for nn.Embed; dense features
+    go to float32."""
+    return (
+        jnp.int32
+        if np.issubdtype(np.asarray(x).dtype, np.integer)
+        else jnp.float32
+    )
+
+
 def _pad_index_map(
     idx_map: dict[int, np.ndarray], num_clients: int, pad_multiple: int
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     counts = np.array([len(idx_map[i]) for i in range(num_clients)], np.int32)
-    max_n = int(max(1, counts.max()))
-    if pad_multiple > 1:
-        max_n = ((max_n + pad_multiple - 1) // pad_multiple) * pad_multiple
+    max_n = _round_up(int(counts.max()), pad_multiple)
     idx = np.zeros((num_clients, max_n), np.int32)
     mask = np.zeros((num_clients, max_n), np.float32)
     for i in range(num_clients):
         n = counts[i]
         idx[i, :n] = idx_map[i]
+        # pad with the client's OWN first sample (not global row 0): masked
+        # rows contribute zero loss/grad either way, but they DO enter
+        # BatchNorm batch statistics — self-padding keeps that content
+        # identical between the global-array and sharded-bank layouts, so
+        # the sharded runtime's equality contract extends to BN models.
+        if n:
+            idx[i, n:] = idx_map[i][0]
         mask[i, :n] = 1.0
     return idx, mask, counts
 
@@ -102,37 +124,114 @@ class FederatedData:
         }
 
     def to_arrays(
-        self, pad_multiple: int = 1, dtype=None
+        self, pad_multiple: int = 1, dtype=None, device: bool = True
     ) -> FederatedArrays:
+        """``device=False`` keeps all leaves as host numpy arrays — used by
+        the mesh-sharded runtime, whose training data lives in per-shard
+        banks instead (jit transfers host leaves on use, e.g. at eval)."""
         if dtype is None:
-            # token datasets (NLP) must stay integer for nn.Embed; dense
-            # features go to float32
-            dtype = (
-                jnp.int32
-                if np.issubdtype(np.asarray(self.x_train).dtype, np.integer)
-                else jnp.float32
-            )
+            dtype = _infer_input_dtype(self.x_train)
         idx, mask, counts = _pad_index_map(
             self.train_idx_map, self.num_clients, pad_multiple
         )
         tidx, tmask, _ = _pad_index_map(
             self.test_idx_map, self.num_clients, pad_multiple
         )
+        conv = jnp.asarray if device else np.asarray
+        np_dtype = np.dtype(dtype.dtype if hasattr(dtype, "dtype") else dtype)
         return FederatedArrays(
-            x=jnp.asarray(self.x_train, dtype),
-            y=jnp.asarray(self.y_train),
-            idx=jnp.asarray(idx),
-            mask=jnp.asarray(mask),
-            counts=jnp.asarray(counts),
-            test_x=jnp.asarray(self.x_test, dtype),
-            test_y=jnp.asarray(self.y_test),
-            test_idx=jnp.asarray(tidx),
-            test_mask=jnp.asarray(tmask),
+            x=conv(self.x_train, dtype if device else np_dtype),
+            y=conv(self.y_train),
+            idx=conv(idx),
+            mask=conv(mask),
+            counts=conv(counts),
+            test_x=conv(self.x_test, dtype if device else np_dtype),
+            test_y=conv(self.y_test),
+            test_idx=conv(tidx),
+            test_mask=conv(tmask),
             num_classes=self.num_classes,
         )
 
 
-def arrays_and_batch(data: "FederatedData", dcfg) -> tuple["FederatedArrays", int]:
+@struct.dataclass
+class ShardedClientBanks:
+    """Per-shard sample banks for the mesh-sharded runtime: shard ``s`` owns
+    clients ``[s*K, (s+1)*K)`` and ONLY their samples — per-device HBM for
+    the data is ~1/n_shards of the global set (the reference keeps data
+    local to silos the same way, ``fedavg_cross_silo/DistWorker.py:31-54``).
+
+    Leading axis = shard; shard over the ``clients`` mesh axis. ``idx``
+    holds LOCAL offsets into the shard's own bank."""
+
+    x: Any  # [S, bank_max, ...]
+    y: Any  # [S, bank_max, ...]
+    idx: Any  # [S, K, max_n] int32 into x[s]
+    mask: Any  # [S, K, max_n] float32 {0,1}
+
+    @property
+    def n_shards(self) -> int:
+        return self.idx.shape[0]
+
+    @property
+    def clients_per_shard(self) -> int:
+        return self.idx.shape[1]
+
+    @property
+    def max_client_samples(self) -> int:
+        return self.idx.shape[2]
+
+
+def shard_client_banks(
+    data: "FederatedData", n_shards: int, pad_multiple: int = 1, dtype=None
+) -> ShardedClientBanks:
+    """Build :class:`ShardedClientBanks` from host-side federated data.
+    ``max_n`` (per-client padded row length) is GLOBAL so every shard's
+    local update runs the same number of steps in lockstep."""
+    n = data.num_clients
+    assert n % n_shards == 0, (n, n_shards)
+    K = n // n_shards
+    if dtype is None:
+        dtype = _infer_input_dtype(data.x_train)
+    counts = np.array(
+        [len(data.train_idx_map[c]) for c in range(n)], np.int64
+    )
+    max_n = _round_up(int(counts.max()), pad_multiple)
+    bank_sizes = [
+        int(counts[s * K : (s + 1) * K].sum()) for s in range(n_shards)
+    ]
+    bank_max = max(1, max(bank_sizes))
+
+    sample_shape = data.x_train.shape[1:]
+    y_shape = data.y_train.shape[1:]
+    xb = np.zeros((n_shards, bank_max) + sample_shape, data.x_train.dtype)
+    yb = np.zeros((n_shards, bank_max) + y_shape, data.y_train.dtype)
+    idx = np.zeros((n_shards, K, max_n), np.int32)
+    mask = np.zeros((n_shards, K, max_n), np.float32)
+    for s in range(n_shards):
+        off = 0
+        for j in range(K):
+            rows = np.asarray(data.train_idx_map[s * K + j])
+            m = len(rows)
+            xb[s, off : off + m] = data.x_train[rows]
+            yb[s, off : off + m] = data.y_train[rows]
+            idx[s, j, :m] = np.arange(off, off + m)
+            # self-pad like _pad_index_map: masked rows must carry the same
+            # content in both layouts (they enter BN batch statistics)
+            if m:
+                idx[s, j, m:] = off
+            mask[s, j, :m] = 1.0
+            off += m
+    return ShardedClientBanks(
+        x=jnp.asarray(xb, dtype),
+        y=jnp.asarray(yb),
+        idx=jnp.asarray(idx),
+        mask=jnp.asarray(mask),
+    )
+
+
+def arrays_and_batch(
+    data: "FederatedData", dcfg, device: bool = True
+) -> tuple["FederatedArrays", int]:
     """Resolve the (arrays, client batch size) pair from a DataConfig,
     honoring full-batch mode (the reference's ``batch_size=-1`` →
     ``combine_batches``, ``fedml_experiments/standalone/utils/dataset.py:158-164``).
@@ -141,7 +240,7 @@ def arrays_and_batch(data: "FederatedData", dcfg) -> tuple["FederatedArrays", in
     ``dcfg.batch_size`` directly, so full-batch mode cannot be silently
     ignored by an algorithm."""
     pad = 1 if dcfg.full_batch else dcfg.batch_size
-    arrays = data.to_arrays(pad_multiple=pad)
+    arrays = data.to_arrays(pad_multiple=pad, device=device)
     max_n = arrays.max_client_samples
     batch = max_n if dcfg.full_batch else min(dcfg.batch_size, max_n)
     return arrays, batch

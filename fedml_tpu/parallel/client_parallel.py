@@ -1,5 +1,7 @@
-"""Mesh-sharded FedAvg: the cohort sharded over the ``clients`` axis, each
-client's batch optionally sharded over the ``data`` axis.
+"""Mesh-sharded FedAvg: the client population statically partitioned over
+the ``clients`` axis — each shard owns a block of clients AND only their
+samples — with each client's batch optionally sharded over the ``data``
+axis.
 
 This is the TPU-native replacement for the reference's two distributed
 layers at once:
@@ -9,13 +11,23 @@ layers at once:
   "upload model / aggregate / broadcast" becomes a weighted pytree ``psum``
   under ``shard_map`` — aggregation rides ICI, no server process exists.
 - ``fedml_api/distributed/fedavg_cross_silo`` (DDP inside each silo over
-  NCCL) -> the ``data`` mesh axis: per-batch gradient ``psum`` inside the
-  compiled local update.
+  NCCL, data local to the silo, ``DistWorker.py:31-54``) -> the ``data``
+  mesh axis: per-batch gradient ``psum`` inside the compiled local update;
+  and like the reference, sample banks stay LOCAL to their shard
+  (:class:`fedml_tpu.data.federated.ShardedClientBanks`), so per-device
+  HBM for the dataset is ~1/n_shards of the global set.
+
+Cohort sampling is *stratified by shard*: every round each shard samples
+``clients_per_round / n_shards`` of its own clients (deterministic in the
+round key). :func:`fedml_tpu.core.random.sample_clients_stratified` is the
+exact host-side mirror, so a single-device :class:`FedAvgSim` constructed
+with that sampler follows the same trajectory — ``tests/test_sharded.py``
+proves equality.
 
 The server step itself is the SAME function as the single-device simulator
 (:func:`fedml_tpu.algorithms.fedavg.server_update`), instantiated with a
 ``psum``/``all_gather`` reducer — so the sharded path cannot drift from the
-reference-equivalent math (and ``tests/test_sharded.py`` proves equality).
+reference-equivalent math.
 """
 
 from __future__ import annotations
@@ -27,7 +39,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from fedml_tpu.config import ExperimentConfig
 from fedml_tpu.core import random as R
-from fedml_tpu.data.federated import FederatedData
+from fedml_tpu.data.federated import FederatedData, shard_client_banks
 from fedml_tpu.algorithms.base import build_local_update, finalize_sums
 from fedml_tpu.algorithms.fedavg import (
     FedAvgSim,
@@ -58,10 +70,17 @@ class ShardedFedAvg(FedAvgSim):
             f"effective cohort size {cohort} must divide evenly over the "
             f"{self.n_client_shards}-way clients mesh axis"
         )
+        assert data.num_clients % self.n_client_shards == 0, (
+            f"population {data.num_clients} must divide evenly over the "
+            f"{self.n_client_shards}-way clients mesh axis (static "
+            "client->shard placement)"
+        )
+        self.cohort_per_shard = cohort // self.n_client_shards
 
-        # FedAvgSim.__init__ builds the single-device local_update; rebuild
-        # it with the data axis threaded through, then wrap the round in
-        # shard_map.
+        # FedAvgSim.__init__ builds the single-device local_update; our
+        # _prepare_data override keeps the global arrays host-side and
+        # builds the per-shard banks; rebuild the local update with the
+        # data axis threaded through, then wrap the round in shard_map.
         super().__init__(model, data, cfg)
         if self.n_data_shards > 1:
             self.local_update = build_local_update(
@@ -75,26 +94,46 @@ class ShardedFedAvg(FedAvgSim):
             )
         self._round_fn = jax.jit(self._sharded_round, donate_argnums=(0,))
 
-    def _sharded_round(self, state: ServerState, arrays):
+    def _prepare_data(self, data, cfg):
+        """Training data lives ONLY in the per-shard banks (per-device HBM
+        ~1/n_shards of the global set); the global FederatedArrays stays as
+        host numpy and is transferred only when evaluation runs."""
+        from fedml_tpu.data.federated import arrays_and_batch
+
+        self.arrays, self.batch_size = arrays_and_batch(
+            data, cfg.data, device=False
+        )
+        self.banks = shard_client_banks(
+            data,
+            self.n_client_shards,
+            pad_multiple=1 if cfg.data.full_batch else cfg.data.batch_size,
+        )
+        assert self.banks.max_client_samples == self.arrays.max_client_samples
+
+    def _sharded_round(self, state: ServerState, banks):
         cfg = self.cfg.fed
         rkey = R.round_key(self.root_key, state.round)
-        cohort = R.sample_clients(
-            jax.random.fold_in(rkey, 0),
-            arrays.num_clients,
-            cfg.clients_per_round,
-        )
-        ckeys = jax.vmap(lambda c: R.client_key(rkey, c))(cohort)
-        idx_rows = arrays.idx[cohort]
-        mask_rows = arrays.mask[cohort]
+        ckey = jax.random.fold_in(rkey, 0)
+        K = banks.clients_per_shard
 
-        cspec = P(self.client_axis)  # shard cohort; replicate over data axis
+        cspec = P(self.client_axis)  # shard banks; replicate over data axis
         rep = P()
         red = psum_reducer(self.client_axis)
 
-        def shard_fn(state, idx_rows, mask_rows, ckeys, x, y):
+        def shard_fn(state, x, y, idx, mask):
+            # leading shard axis arrives with extent 1 inside the shard
+            x, y = x[0], y[0]
+            idx, mask = idx[0], mask[0]
+            shard = jax.lax.axis_index(self.client_axis)
+            # stratified cohort: this shard samples its own clients (LOCAL
+            # ids); keys use GLOBAL client ids so the host mirror matches
+            local = R.sample_stratum(ckey, shard, K, self.cohort_per_shard)
+            ckeys = jax.vmap(
+                lambda c: R.client_key(rkey, shard * K + c)
+            )(local)
             stacked_vars, n_k, msums = jax.vmap(
                 self.local_update, in_axes=(None, 0, 0, None, None, 0)
-            )(state.variables, idx_rows, mask_rows, x, y, ckeys)
+            )(state.variables, idx[local], mask[local], x, y, ckeys)
 
             new_state = server_update(
                 cfg,
@@ -117,8 +156,11 @@ class ShardedFedAvg(FedAvgSim):
         new_state, metrics = shard_map(
             shard_fn,
             mesh=self.mesh,
-            in_specs=(rep, cspec, cspec, cspec, rep, rep),
+            in_specs=(rep, cspec, cspec, cspec, cspec),
             out_specs=(rep, rep),
             check_vma=False,
-        )(state, idx_rows, mask_rows, ckeys, arrays.x, arrays.y)
+        )(state, banks.x, banks.y, banks.idx, banks.mask)
         return new_state, metrics
+
+    def run_round(self, state):
+        return self._round_fn(state, self.banks)

@@ -17,9 +17,16 @@ from fedml_tpu.config import (
     TrainConfig,
 )
 from fedml_tpu.algorithms.fedavg import FedAvgSim
+from fedml_tpu.core import random as R
 from fedml_tpu.data.loaders import load_dataset
 from fedml_tpu.models import create_model
 from fedml_tpu.parallel import ShardedFedAvg, make_mesh
+
+
+def stratified(n_strata):
+    """Host-side mirror of the sharded runtime's per-shard sampling, so a
+    single-device FedAvgSim follows the identical trajectory."""
+    return lambda k, n, c: R.sample_clients_stratified(k, n, c, n_strata)
 
 
 def cfg_for(mesh_cfg, **overrides):
@@ -43,8 +50,11 @@ def test_sharded_matches_single_device():
     data = load_dataset(cfg.data)
     model = create_model(cfg.model)
 
-    single = FedAvgSim(model, data, cfg)
+    single = FedAvgSim(model, data, cfg, sampler=stratified(8))
     sharded = ShardedFedAvg(model, data, cfg, mesh)
+    # the sample banks are sharded: per-device data is ~1/n_shards
+    assert sharded.banks.x.shape[0] == 8
+    assert sharded.banks.x.shape[1] < data.x_train.shape[0]
 
     s1, m1 = single.run_round(single.init())
     s2, m2 = sharded.run_round(sharded.init())
@@ -74,7 +84,7 @@ def test_data_axis_matches_single_device():
     data = load_dataset(cfg.data)
     model = create_model(cfg.model)
 
-    single = FedAvgSim(model, data, cfg)
+    single = FedAvgSim(model, data, cfg, sampler=stratified(2))
     sharded = ShardedFedAvg(model, data, cfg, mesh)
     s1, _ = single.run_round(single.init())
     s2, _ = sharded.run_round(sharded.init())
@@ -99,7 +109,42 @@ def test_sharded_variants_match(fed):
     cfg = cfg_for(MeshConfig(client_axis_size=4, data_axis_size=1), fed=fed)
     data = load_dataset(cfg.data)
     model = create_model(cfg.model)
-    single = FedAvgSim(model, data, cfg)
+    single = FedAvgSim(model, data, cfg, sampler=stratified(4))
+    sharded = ShardedFedAvg(model, data, cfg, mesh)
+    s1, _ = single.run_round(single.init())
+    s2, _ = sharded.run_round(sharded.init())
+    for a, b in zip(
+        jax.tree.leaves(s1.variables), jax.tree.leaves(s2.variables)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-5
+        )
+
+
+@pytest.mark.slow
+def test_sharded_matches_single_device_batchnorm_model():
+    """BatchNorm models: masked pad rows enter BN batch statistics, so the
+    equality contract requires identical pad CONTENT in both layouts
+    (self-padding with the client's own first sample — see
+    federated._pad_index_map / shard_client_banks)."""
+    mesh = make_mesh(client_axis=4, data_axis=1)
+    cfg = cfg_for(
+        MeshConfig(client_axis_size=4, data_axis_size=1),
+        model=ModelConfig(
+            name="resnet8", num_classes=10, input_shape=(16, 16, 3)
+        ),
+        data=DataConfig(
+            dataset="fake_cifar10", num_clients=8, batch_size=16, seed=3,
+            partition_method="hetero", partition_alpha=0.5, dataset_r=0.05,
+        ),
+        fed=FedConfig(num_rounds=1, clients_per_round=4, eval_every=1),
+    )
+    data = load_dataset(cfg.data)
+    # shrink images to 16x16 to keep the CPU compile fast
+    data.x_train = data.x_train[:, ::2, ::2, :]
+    data.x_test = data.x_test[:, ::2, ::2, :]
+    model = create_model(cfg.model)
+    single = FedAvgSim(model, data, cfg, sampler=stratified(4))
     sharded = ShardedFedAvg(model, data, cfg, mesh)
     s1, _ = single.run_round(single.init())
     s2, _ = sharded.run_round(sharded.init())
