@@ -369,6 +369,111 @@ def load_cifar_arrays(data_dir: str, name: str):
     return x_tr, y_tr, x_te, y_te, 100
 
 
+def load_emnist_arrays(data_dir: str, split: str = "balanced"):
+    """EMNIST from IDX files (reference
+    ``EMNIST/data_loader.py`` via torchvision's ``EMNIST(split='balanced')``
+    — the underlying files are gzipped IDX like MNIST). 47 classes for the
+    'balanced' split, 62 for 'byclass'."""
+    nc = {"balanced": 47, "byclass": 62, "digits": 10, "letters": 26}[split]
+    files = {
+        k: [f"emnist-{split}-{k2}-idx{d}-ubyte.gz",
+            f"emnist-{split}-{k2}-idx{d}-ubyte"]
+        for k, (k2, d) in {
+            "x_tr": ("train-images", 3), "y_tr": ("train-labels", 1),
+            "x_te": ("test-images", 3), "y_te": ("test-labels", 1),
+        }.items()
+    }
+    paths = {k: _find(data_dir, v) for k, v in files.items()}
+    if any(p is None for p in paths.values()):
+        raise FileNotFoundError(
+            f"EMNIST ({split}) IDX files not found under {data_dir}; fetch "
+            "with the reference's data scripts or use dataset='fake_emnist'"
+        )
+    # torchvision stores EMNIST transposed (H/W swapped) vs MNIST; the IDX
+    # source files share that orientation — normalize like the reference
+    # (_data_transforms_emnist: mean .5, std .5)
+    x_tr = _read_idx(paths["x_tr"]).astype(np.float32)[..., None] / 255.0
+    x_te = _read_idx(paths["x_te"]).astype(np.float32)[..., None] / 255.0
+    return (
+        (x_tr - 0.5) / 0.5,
+        _read_idx(paths["y_tr"]).astype(np.int32),
+        (x_te - 0.5) / 0.5,
+        _read_idx(paths["y_te"]).astype(np.int32),
+        nc,
+    )
+
+
+def load_image_folder_arrays(data_dir: str, name: str = "cinic10"):
+    """CINIC-10-style ImageFolder tree (reference ``cinic10/data_loader.py``
+    via ``ImageFolderTruncated``): ``<root>/train/<class>/*.png`` and
+    ``<root>/test/<class>/*.png`` (a ``valid/`` split, if present, is folded
+    into train like common CINIC practice). Decoded with PIL."""
+    from PIL import Image
+
+    mean = np.array([0.47889522, 0.47227842, 0.43047404], np.float32)
+    std = np.array([0.24205776, 0.23828046, 0.25874835], np.float32)
+    root = _find(data_dir, [name, "CINIC-10", "."])
+    if root is None or not os.path.isdir(os.path.join(root, "train")):
+        raise FileNotFoundError(
+            f"ImageFolder tree (train/<class>/*.png) not found under "
+            f"{data_dir}; use dataset='fake_{name}' for offline runs"
+        )
+
+    # one canonical class list (from train/) so every split labels by the
+    # same name->id map even if a split is missing a class directory
+    train_dir = os.path.join(root, "train")
+    classes = sorted(
+        c
+        for c in os.listdir(train_dir)
+        if os.path.isdir(os.path.join(train_dir, c))
+    )
+    class_id = {c: i for i, c in enumerate(classes)}
+
+    def read_split(split):
+        d = os.path.join(root, split)
+        if not os.path.isdir(d):
+            return None, None
+        extra = [
+            c
+            for c in os.listdir(d)
+            if os.path.isdir(os.path.join(d, c)) and c not in class_id
+        ]
+        if extra:
+            raise ValueError(
+                f"{d} has class dirs {extra} not present in train/"
+            )
+        xs, ys = [], []
+        for c in classes:
+            cd = os.path.join(d, c)
+            if not os.path.isdir(cd):
+                continue
+            for fn in sorted(os.listdir(cd)):
+                if not fn.lower().endswith((".png", ".jpg", ".jpeg")):
+                    continue
+                img = np.asarray(
+                    Image.open(os.path.join(cd, fn)).convert("RGB"),
+                    np.float32,
+                ) / 255.0
+                xs.append((img - mean) / std)
+                ys.append(class_id[c])
+        if not xs:
+            return None, None
+        return np.stack(xs), np.asarray(ys, np.int32)
+
+    x_tr, y_tr = read_split("train")
+    x_te, y_te = read_split("test")
+    if x_tr is None or x_te is None:
+        raise FileNotFoundError(
+            f"empty ImageFolder tree under {root}; use "
+            f"dataset='fake_{name}'"
+        )
+    x_va, y_va = read_split("valid")
+    if x_va is not None:
+        x_tr = np.concatenate([x_tr, x_va])
+        y_tr = np.concatenate([y_tr, y_va])
+    return x_tr, y_tr, x_te, y_te, max(len(classes), 1)
+
+
 # ---------------------------------------------------------------------------
 # Dispatch
 # ---------------------------------------------------------------------------
@@ -424,15 +529,27 @@ def load_dataset(cfg: DataConfig) -> FederatedData:
             cfg.data_dir, nc2, x_shape=shape,
             offline_hint="fake_femnist" if base == "femnist" else None,
         )
+    if name in ("fed_shakespeare", "shakespeare"):
+        from fedml_tpu.data.natural import load_fed_shakespeare
+
+        return load_fed_shakespeare(cfg.data_dir)
+    if name == "stackoverflow_nwp":
+        from fedml_tpu.data.natural import load_stackoverflow_nwp
+
+        return load_stackoverflow_nwp(cfg.data_dir)
+    if name == "stackoverflow_lr":
+        from fedml_tpu.data.natural import load_stackoverflow_lr
+
+        return load_stackoverflow_lr(cfg.data_dir)
     if name == "mnist":
         x_tr, y_tr, x_te, y_te, nc = load_mnist_arrays(cfg.data_dir)
     elif name in ("cifar10", "cifar100"):
         x_tr, y_tr, x_te, y_te, nc = load_cifar_arrays(cfg.data_dir, name)
-    elif name in ("emnist", "cinic10"):
-        raise FileNotFoundError(
-            f"offline build has no real-file reader for '{name}' (the "
-            f"reference downloads it via data/{name} scripts); use "
-            f"dataset='fake_{name}' which matches its shapes/classes"
+    elif name == "emnist":
+        x_tr, y_tr, x_te, y_te, nc = load_emnist_arrays(cfg.data_dir)
+    elif name == "cinic10":
+        x_tr, y_tr, x_te, y_te, nc = load_image_folder_arrays(
+            cfg.data_dir, name
         )
     else:
         raise ValueError(f"unknown dataset: {cfg.dataset}")

@@ -204,3 +204,219 @@ def backdoor_success_rate(model, variables, trig_x, trig_y) -> float:
     logits = model.apply_eval(variables, jnp.asarray(trig_x))
     pred = np.asarray(jnp.argmax(logits, -1))
     return float(np.mean(pred == trig_y))
+
+
+# ---------------------------------------------------------------------------
+# TFF text datasets: fed_shakespeare + stackoverflow (nwp / lr)
+# ---------------------------------------------------------------------------
+
+# Character vocabulary from the TFF text-generation tutorial, used verbatim
+# by the reference (``fed_shakespeare/utils.py`` CHAR_VOCAB). Token ids:
+# 0 = pad, 1..86 = chars, 87 = bos, 88 = eos, 89 = oov.
+SHAKESPEARE_CHARS = list(
+    "dhlptx@DHLPTX $(,048cgkoswCGKOSW[_#'/37;?bfjnrvzBFJNRVZ\"&*.26:"
+    "\naeimquyAEIMQUY]!%)-159\r"
+)
+SHAKESPEARE_VOCAB_SIZE = len(SHAKESPEARE_CHARS) + 4  # pad + bos + eos + oov
+SHAKESPEARE_SEQ_LEN = 80
+
+
+def shakespeare_to_sequences(
+    snippets: list[str], seq_len: int = SHAKESPEARE_SEQ_LEN
+) -> np.ndarray:
+    """Tokenize snippets exactly like the reference
+    (``fed_shakespeare/utils.py:preprocess``): per snippet,
+    ``[bos] + chars + [eos]``, zero-padded to a multiple of ``seq_len+1``,
+    then chopped into ``[seq_len+1]`` windows. Returns ``[n, seq_len+1]``
+    int32 (callers split into x = [:, :-1] / y = [:, 1:])."""
+    char_id = {c: i + 1 for i, c in enumerate(SHAKESPEARE_CHARS)}
+    n_words = len(SHAKESPEARE_CHARS) + 3  # pad + chars + bos + eos
+    bos, eos, oov = n_words - 2, n_words - 1, n_words
+    seqs = []
+    for sn in snippets:
+        tokens = [bos] + [char_id.get(c, oov) for c in sn] + [eos]
+        pad = (-len(tokens)) % (seq_len + 1)
+        tokens += [0] * pad
+        for i in range(0, len(tokens), seq_len + 1):
+            seqs.append(tokens[i : i + seq_len + 1])
+    if not seqs:
+        return np.zeros((0, seq_len + 1), np.int32)
+    return np.asarray(seqs, np.int32)
+
+
+def _build_text_federated(
+    train_p: str,
+    test_p: str,
+    read_client,
+    num_classes: int,
+    task: str,
+    fake_name: str,
+) -> FederatedData:
+    """Shared tail of the TFF text loaders: read both h5 splits with
+    ``read_client`` (a per-client (x, y) producer over _iter_h5_text rows),
+    build natural maps, and pool the test split if its client list does not
+    align with train."""
+    _require(train_p, fake_name)
+    _require(test_p, fake_name)
+    train = [read_client(rows) for _, rows in _iter_h5_text_groups(train_p)]
+    test = [read_client(rows) for _, rows in _iter_h5_text_groups(test_p)]
+    x_tr, y_tr, tr_map = _natural_maps(train)
+    x_te, y_te, te_map = _natural_maps(test)
+    if len(te_map) != len(tr_map):  # clients must align; pool test otherwise
+        te_map = {i: np.arange(len(x_te)) for i in range(len(tr_map))}
+    return FederatedData(
+        x_tr, y_tr, x_te, y_te, tr_map, te_map, num_classes, task
+    )
+
+
+def _iter_h5_text_groups(path: str):
+    """Iterate (client_id, {field: [decoded strings]}) from a TFF text h5."""
+    import h5py
+
+    with h5py.File(path, "r") as f:
+        ex = f["examples"]
+        for cid in ex.keys():
+            g = ex[cid]
+            yield cid, {
+                field: [s.decode("utf8") for s in g[field][()]]
+                for field in g.keys()
+            }
+
+
+def load_fed_shakespeare(
+    data_dir: str, seq_len: int = SHAKESPEARE_SEQ_LEN
+) -> FederatedData:
+    """fed_shakespeare from the TFF h5 pair (reference
+    ``fed_shakespeare/data_loader.py:27-70``: ``shakespeare_train.h5`` /
+    ``shakespeare_test.h5``, group ``examples/<client_id>/snippets`` of
+    utf-8 bytes). Char-LM next-character prediction: x = tokens[:, :-1],
+    y = tokens[:, 1:] (reference ``utils.split``)."""
+    def read_client(rows):
+        seqs = shakespeare_to_sequences(rows["snippets"], seq_len)
+        return seqs[:, :-1], seqs[:, 1:]
+
+    return _build_text_federated(
+        os.path.join(data_dir, "shakespeare_train.h5"),
+        os.path.join(data_dir, "shakespeare_test.h5"),
+        read_client,
+        SHAKESPEARE_VOCAB_SIZE,
+        "nwp",
+        "fake_shakespeare",
+    )
+
+
+def _read_word_count(path: str, vocab_size: int) -> dict[str, int]:
+    """Top-``vocab_size`` words from a TFF ``stackoverflow.word_count``
+    file: one ``word count`` pair per line, most frequent first (reference
+    ``stackoverflow_nwp/utils.py:get_most_frequent_words``)."""
+    words = {}
+    with open(path) as f:
+        for line in f:
+            w = line.split()[0]
+            words[w] = len(words)
+            if len(words) >= vocab_size:
+                break
+    return words
+
+
+def stackoverflow_to_sequences(
+    sentences: list[str],
+    word_dict: dict[str, int],
+    seq_len: int = 20,
+) -> np.ndarray:
+    """Tokenize like the reference (``stackoverflow_nwp/utils.py:tokenizer``):
+    truncate to ``seq_len`` words, append eos if short, prepend bos, pad to
+    ``seq_len+1``. Ids: 0=pad, 1..V=words, V+1=bos, V+2=eos, V+3=oov."""
+    V = len(word_dict)
+    bos, eos, oov = V + 1, V + 2, V + 3
+    out = np.zeros((len(sentences), seq_len + 1), np.int32)
+    for i, sen in enumerate(sentences):
+        words = sen.split(" ")[:seq_len]
+        tokens = [word_dict[w] + 1 if w in word_dict else oov for w in words]
+        if len(tokens) < seq_len:
+            tokens.append(eos)
+        tokens = [bos] + tokens
+        out[i, : len(tokens)] = tokens
+    return out
+
+
+def load_stackoverflow_nwp(
+    data_dir: str, vocab_size: int = 10000, seq_len: int = 20
+) -> FederatedData:
+    """stackoverflow next-word prediction from the TFF h5 pair (reference
+    ``stackoverflow_nwp/data_loader.py`` + ``dataset.py``:
+    ``stackoverflow_train.h5`` / ``stackoverflow_test.h5``, group
+    ``examples/<client_id>/tokens`` of utf-8 sentences, word vocabulary from
+    ``stackoverflow.word_count``). x = tokens[:, :-1], y = tokens[:, 1:]
+    (shifted LM targets over all positions, TFF's evaluation convention)."""
+    wc = os.path.join(data_dir, "stackoverflow.word_count")
+    _require(wc, "fake_stackoverflow_nwp")
+    word_dict = _read_word_count(wc, vocab_size)
+
+    def read_client(rows):
+        seqs = stackoverflow_to_sequences(rows["tokens"], word_dict, seq_len)
+        return seqs[:, :-1], seqs[:, 1:]
+
+    return _build_text_federated(
+        os.path.join(data_dir, "stackoverflow_train.h5"),
+        os.path.join(data_dir, "stackoverflow_test.h5"),
+        read_client,
+        len(word_dict) + 4,
+        "nwp",
+        "fake_stackoverflow_nwp",
+    )
+
+
+def load_stackoverflow_lr(
+    data_dir: str, vocab_size: int = 10000, tag_size: int = 500
+) -> FederatedData:
+    """stackoverflow tag prediction from the TFF h5 pair (reference
+    ``stackoverflow_lr/data_loader.py`` + ``utils.py``): inputs = mean
+    one-hot bag-of-words over the top-``vocab_size`` words
+    (``preprocess_inputs``), targets = multi-hot over the top-``tag_size``
+    tags from the ``stackoverflow.tag_count`` json
+    (``preprocess_targets``)."""
+    wc = os.path.join(data_dir, "stackoverflow.word_count")
+    tc = os.path.join(data_dir, "stackoverflow.tag_count")
+    _require(wc, "fake_stackoverflow_lr")
+    _require(tc, "fake_stackoverflow_lr")
+    word_dict = _read_word_count(wc, vocab_size)
+    with open(tc) as f:
+        tag_dict = {
+            t: i for i, t in enumerate(list(json.load(f).keys())[:tag_size])
+        }
+
+    def bag_of_words(sens):
+        x = np.zeros((len(sens), len(word_dict)), np.float32)
+        for i, sen in enumerate(sens):
+            words = sen.split(" ")
+            n = len(words)
+            if n == 0:
+                continue
+            for w in words:
+                j = word_dict.get(w)
+                if j is not None:  # oov column is sliced off like reference
+                    x[i, j] += 1.0
+            x[i] /= n  # mean over tokens INCLUDING oov hits
+        return x
+
+    def multi_hot_tags(tags):
+        y = np.zeros((len(tags), len(tag_dict)), np.float32)
+        for i, tg in enumerate(tags):
+            for t in tg.split("|"):
+                j = tag_dict.get(t)
+                if j is not None:
+                    y[i, j] = 1.0
+        return y
+
+    def read_client(rows):
+        return bag_of_words(rows["tokens"]), multi_hot_tags(rows["tags"])
+
+    return _build_text_federated(
+        os.path.join(data_dir, "stackoverflow_train.h5"),
+        os.path.join(data_dir, "stackoverflow_test.h5"),
+        read_client,
+        len(tag_dict),
+        "tag_prediction",
+        "fake_stackoverflow_lr",
+    )

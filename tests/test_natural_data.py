@@ -111,3 +111,198 @@ def test_backdoor_and_robust_aggregation():
         )
     # median should not be MORE backdoored than plain mean
     assert results["median"] <= results["mean"] + 0.05, results
+
+
+# ---------------------------------------------------------------------------
+# Real-file text loaders (fed_shakespeare, stackoverflow nwp/lr)
+# ---------------------------------------------------------------------------
+
+
+def _write_text_h5(path, field_rows: dict):
+    """field_rows: {client_id: {field: [str, ...]}}"""
+    import h5py
+
+    with h5py.File(path, "w") as f:
+        ex = f.create_group("examples")
+        for cid, fields in field_rows.items():
+            g = ex.create_group(cid)
+            for field, rows in fields.items():
+                g.create_dataset(
+                    field, data=np.array([r.encode("utf8") for r in rows])
+                )
+
+
+def test_fed_shakespeare_h5_roundtrip(tmp_path):
+    from fedml_tpu.data.natural import (
+        SHAKESPEARE_CHARS,
+        SHAKESPEARE_VOCAB_SIZE,
+        load_fed_shakespeare,
+        shakespeare_to_sequences,
+    )
+
+    snippet = "To be, or not to be"
+    _write_text_h5(
+        tmp_path / "shakespeare_train.h5",
+        {"c0": {"snippets": [snippet]}, "c1": {"snippets": ["ay\nthere"]}},
+    )
+    _write_text_h5(
+        tmp_path / "shakespeare_test.h5",
+        {"c0": {"snippets": [snippet]}, "c1": {"snippets": ["the rub"]}},
+    )
+    data = load_fed_shakespeare(str(tmp_path))
+    assert data.task == "nwp"
+    assert data.num_classes == SHAKESPEARE_VOCAB_SIZE == 90
+    assert data.num_clients == 2
+    assert data.x_train.shape[1] == 80
+    # tokenization parity with the reference's preprocess():
+    # [bos] + char ids + [eos], zero-padded to 81
+    seqs = shakespeare_to_sequences([snippet])
+    assert seqs.shape == (1, 81)
+    bos = len(SHAKESPEARE_CHARS) + 1
+    eos = len(SHAKESPEARE_CHARS) + 2
+    assert seqs[0, 0] == bos
+    char_id = {c: i + 1 for i, c in enumerate(SHAKESPEARE_CHARS)}
+    assert seqs[0, 1] == char_id["T"]
+    assert seqs[0, len(snippet) + 1] == eos
+    assert (seqs[0, len(snippet) + 2 :] == 0).all()  # pad
+    # y is x shifted by one (next-char targets)
+    np.testing.assert_array_equal(data.x_train[0, 1:], data.y_train[0, :-1])
+
+
+def test_stackoverflow_nwp_h5_roundtrip(tmp_path):
+    from fedml_tpu.data.natural import (
+        load_stackoverflow_nwp,
+        stackoverflow_to_sequences,
+    )
+
+    vocab = [f"w{i}" for i in range(30)]
+    (tmp_path / "stackoverflow.word_count").write_text(
+        "".join(f"{w} {1000 - i}\n" for i, w in enumerate(vocab))
+    )
+    _write_text_h5(
+        tmp_path / "stackoverflow_train.h5",
+        {"u0": {"tokens": ["w0 w1 w2", "w3 unknownword"]},
+         "u1": {"tokens": ["w4 w5"]}},
+    )
+    _write_text_h5(
+        tmp_path / "stackoverflow_test.h5",
+        {"u0": {"tokens": ["w1 w2"]}, "u1": {"tokens": ["w0"]}},
+    )
+    data = load_stackoverflow_nwp(str(tmp_path), vocab_size=30, seq_len=5)
+    assert data.task == "nwp"
+    assert data.num_classes == 34  # 30 words + pad + bos + eos + oov
+    assert data.num_clients == 2
+    assert data.x_train.shape == (3, 5)
+    word_dict = {w: i for i, w in enumerate(vocab)}
+    seqs = stackoverflow_to_sequences(["w0 w1 w2"], word_dict, seq_len=5)
+    bos, eos, oov = 31, 32, 33
+    # [bos, w0, w1, w2, eos, pad]: short sentence gets eos then pad
+    np.testing.assert_array_equal(seqs[0], [bos, 1, 2, 3, eos, 0])
+    # oov words map to the oov bucket
+    seqs = stackoverflow_to_sequences(["zzz w0"], word_dict, seq_len=5)
+    assert seqs[0, 1] == oov
+
+
+def test_stackoverflow_lr_h5_roundtrip(tmp_path):
+    from fedml_tpu.data.natural import load_stackoverflow_lr
+
+    vocab = ["alpha", "beta", "gamma"]
+    (tmp_path / "stackoverflow.word_count").write_text(
+        "alpha 10\nbeta 9\ngamma 8\n"
+    )
+    (tmp_path / "stackoverflow.tag_count").write_text(
+        json.dumps({"python": 100, "jax": 50, "tpu": 25})
+    )
+    _write_text_h5(
+        tmp_path / "stackoverflow_train.h5",
+        {"u0": {"tokens": ["alpha beta", "gamma gamma oovword"],
+                "tags": ["python|jax", "tpu"]},
+         "u1": {"tokens": ["alpha"], "tags": ["python"]}},
+    )
+    _write_text_h5(
+        tmp_path / "stackoverflow_test.h5",
+        {"u0": {"tokens": ["beta"], "tags": ["jax"]},
+         "u1": {"tokens": ["gamma"], "tags": ["tpu"]}},
+    )
+    data = load_stackoverflow_lr(str(tmp_path), vocab_size=3, tag_size=3)
+    assert data.task == "tag_prediction"
+    assert data.num_classes == 3
+    assert data.x_train.shape == (3, 3)
+    # "alpha beta" -> mean one-hot = [.5, .5, 0]
+    np.testing.assert_allclose(data.x_train[0], [0.5, 0.5, 0.0])
+    # "gamma gamma oovword" -> [0, 0, 2/3] (oov counts in the denominator)
+    np.testing.assert_allclose(data.x_train[1], [0, 0, 2 / 3], atol=1e-6)
+    # tags "python|jax" -> [1, 1, 0]
+    np.testing.assert_array_equal(data.y_train[0], [1, 1, 0])
+
+
+def test_emnist_idx_roundtrip(tmp_path):
+    import gzip
+    import struct
+
+    from fedml_tpu.data.loaders import load_emnist_arrays
+
+    rng = np.random.default_rng(0)
+
+    def write_idx(path, arr):
+        arr = np.ascontiguousarray(arr)
+        header = struct.pack(
+            ">HBB", 0, 8, arr.ndim
+        ) + struct.pack(">" + "I" * arr.ndim, *arr.shape)
+        with gzip.open(path, "wb") as f:
+            f.write(header + arr.astype(np.uint8).tobytes())
+
+    write_idx(tmp_path / "emnist-balanced-train-images-idx3-ubyte.gz",
+              rng.integers(0, 255, (20, 28, 28)))
+    write_idx(tmp_path / "emnist-balanced-train-labels-idx1-ubyte.gz",
+              rng.integers(0, 47, (20,)))
+    write_idx(tmp_path / "emnist-balanced-test-images-idx3-ubyte.gz",
+              rng.integers(0, 255, (8, 28, 28)))
+    write_idx(tmp_path / "emnist-balanced-test-labels-idx1-ubyte.gz",
+              rng.integers(0, 47, (8,)))
+    x_tr, y_tr, x_te, y_te, nc = load_emnist_arrays(str(tmp_path))
+    assert x_tr.shape == (20, 28, 28, 1) and nc == 47
+    assert x_te.shape == (8, 28, 28, 1)
+    assert np.abs(x_tr).max() <= 1.0 + 1e-6  # (x/255 - .5)/.5 in [-1, 1]
+
+
+def test_cinic10_image_folder_roundtrip(tmp_path):
+    from PIL import Image
+
+    from fedml_tpu.data.loaders import load_image_folder_arrays
+
+    rng = np.random.default_rng(0)
+    classes = ["airplane", "cat"]
+    for split, n in (("train", 3), ("valid", 2), ("test", 2)):
+        for c in classes:
+            d = tmp_path / "cinic10" / split / c
+            d.mkdir(parents=True)
+            for i in range(n):
+                Image.fromarray(
+                    rng.integers(0, 255, (32, 32, 3)).astype(np.uint8)
+                ).save(d / f"img{i}.png")
+    x_tr, y_tr, x_te, y_te, nc = load_image_folder_arrays(
+        str(tmp_path), "cinic10"
+    )
+    assert nc == 2
+    assert x_tr.shape == (10, 32, 32, 3)  # train(6) + valid(4) folded in
+    assert x_te.shape == (4, 32, 32, 3)
+    assert set(np.unique(y_tr)) == {0, 1}
+
+
+def test_real_text_datasets_via_dispatch(tmp_path):
+    """load_dataset() routes the real names to the h5 readers."""
+    from fedml_tpu.data.loaders import load_dataset
+
+    _write_text_h5(
+        tmp_path / "shakespeare_train.h5",
+        {"c0": {"snippets": ["hello world"]}},
+    )
+    _write_text_h5(
+        tmp_path / "shakespeare_test.h5",
+        {"c0": {"snippets": ["bye"]}},
+    )
+    data = load_dataset(
+        DataConfig(dataset="fed_shakespeare", data_dir=str(tmp_path))
+    )
+    assert data.task == "nwp" and data.num_clients == 1
