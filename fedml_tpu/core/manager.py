@@ -24,10 +24,14 @@ def create_transport(
     *,
     hub=None,
     ip_config: dict[int, tuple[str, int]] | None = None,
+    bus=None,
+    store=None,
+    size: int | None = None,
 ) -> BaseTransport:
     """Backend dispatch by name (reference ``client_manager.py:28-50``:
     backend in {MPI, MQTT, MQTT_S3, GRPC, TRPC}; here {LOOPBACK, TCP,
-    GRPC})."""
+    GRPC, PUBSUB, PUBSUB_BLOB} — PUBSUB is the MQTT-shaped topic bus,
+    PUBSUB_BLOB adds the S3-shaped control/data-plane split)."""
     backend = backend.upper()
     if backend == "LOOPBACK":
         assert hub is not None, "loopback needs a shared LoopbackHub"
@@ -42,6 +46,16 @@ def create_transport(
 
         assert ip_config is not None
         return GrpcTransport(rank, ip_config)
+    if backend in ("PUBSUB", "MQTT"):
+        from fedml_tpu.core.transport.pubsub import PubSubTransport
+
+        assert bus is not None and size is not None
+        return PubSubTransport(rank, bus, size)
+    if backend in ("PUBSUB_BLOB", "MQTT_S3"):
+        from fedml_tpu.core.transport.pubsub import PubSubBlobTransport
+
+        assert bus is not None and store is not None and size is not None
+        return PubSubBlobTransport(rank, bus, store, size)
     raise ValueError(f"unknown backend: {backend}")
 
 

@@ -1,0 +1,205 @@
+"""Pub-sub (MQTT-shaped) + blob-store (S3-shaped) transports.
+
+The reference's production cross-silo path is MQTT for the control plane
+and S3 for bulk model blobs:
+
+- ``MqttCommManager`` (``fedml_core/distributed/communication/mqtt/
+  mqtt_comm_manager.py:14``): broker pub/sub with the topic scheme
+  *server publishes* ``{prefix}0_{client}``, *client publishes*
+  ``{prefix}{client}``; full model params ride inline.
+- ``MqttS3CommManager`` (``mqtt_s3/mqtt_s3_comm_manager.py:172-211``):
+  ``send_message`` swaps the ``model_params`` payload entry for an S3 key
+  (+ presigned URL) after uploading the blob; the receiver re-inflates it
+  (``:141-163``). ``S3Storage`` (``remote_storage.py:14``) is put/get of
+  serialized params.
+
+This module provides the same two backends with the broker and object
+store behind tiny interfaces:
+
+- :class:`TopicBus` — in-process broker (topic -> subscribers). A real
+  deployment would adapt this interface onto an external broker; every
+  message still round-trips the full wire codec so the behavior under test
+  is the real one.
+- :class:`BlobStore` — S3-shaped put/get with generated keys and mock
+  "presigned URLs". ``root`` = in-memory dict, or a directory for
+  cross-process file-backed blobs.
+- :class:`PubSubTransport` — MQTT-shaped: whole message on the topic.
+- :class:`PubSubBlobTransport` — MQTT+S3-shaped: control/data-plane split;
+  any payload entry under ``KEY_MODEL_PARAMS`` moves to the blob store and
+  only its key + URL ride the topic.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import uuid
+from typing import Callable
+
+from fedml_tpu.core.message import KEY_MODEL_PARAMS, Message
+from fedml_tpu.core.transport.base import BaseTransport
+
+KEY_BLOB = "model_params_blob_key"
+KEY_BLOB_URL = "model_params_url"
+
+
+class TopicBus:
+    """In-process MQTT-broker stand-in: publish/subscribe on string topics.
+
+    Thread-safe; callbacks run on the publisher's thread (like paho's
+    network loop thread calling ``on_message``)."""
+
+    def __init__(self):
+        self._subs: dict[str, list[Callable[[str, bytes], None]]] = {}
+        self._lock = threading.Lock()
+
+    def subscribe(self, topic: str, callback: Callable[[str, bytes], None]):
+        with self._lock:
+            self._subs.setdefault(topic, []).append(callback)
+
+    def publish(self, topic: str, payload: bytes):
+        with self._lock:
+            subs = list(self._subs.get(topic, ()))
+        for cb in subs:
+            cb(topic, payload)
+
+
+class BlobStore:
+    """S3-shaped object store (reference ``S3Storage``,
+    ``remote_storage.py:14``): ``put`` returns a mock presigned URL,
+    ``get`` fetches by key. ``root=None`` keeps blobs in memory; a
+    directory path makes them file-backed (cross-process)."""
+
+    def __init__(self, root: str | None = None, bucket: str = "fedml"):
+        self.root = root
+        self.bucket = bucket
+        self._mem: dict[str, bytes] = {}
+        self._lock = threading.Lock()
+        if root is not None:
+            os.makedirs(root, exist_ok=True)
+
+    def put(self, key: str, data: bytes) -> str:
+        if self.root is None:
+            with self._lock:
+                self._mem[key] = data
+        else:
+            tmp = os.path.join(self.root, f".{key}.tmp")
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.replace(tmp, os.path.join(self.root, key))
+        return f"blob://{self.bucket}/{key}?presigned=1"  # mock presign
+
+    def get(self, key: str) -> bytes:
+        if self.root is None:
+            with self._lock:
+                return self._mem[key]
+        with open(os.path.join(self.root, key), "rb") as f:
+            return f.read()
+
+    def delete(self, key: str) -> None:
+        if self.root is None:
+            with self._lock:
+                self._mem.pop(key, None)
+        else:
+            try:
+                os.remove(os.path.join(self.root, key))
+            except FileNotFoundError:
+                pass
+
+
+class PubSubTransport(BaseTransport):
+    """MQTT-shaped transport over a :class:`TopicBus`.
+
+    Topic scheme mirrors the reference (``mqtt_comm_manager.py:47-57``):
+    rank 0 (server) publishes to ``{prefix}0_{receiver}`` and subscribes to
+    every ``{prefix}{client}``; clients publish ``{prefix}{rank}`` and
+    subscribe ``{prefix}0_{rank}``."""
+
+    def __init__(
+        self,
+        rank: int,
+        bus: TopicBus,
+        size: int,
+        topic_prefix: str = "fedml_",
+    ):
+        super().__init__(rank)
+        self.bus = bus
+        self.size = size
+        self.prefix = topic_prefix
+        if rank == 0:
+            for c in range(1, size):
+                bus.subscribe(f"{self.prefix}{c}", self._on_message)
+        else:
+            bus.subscribe(f"{self.prefix}0_{rank}", self._on_message)
+
+    def _topic_for(self, receiver: int) -> str:
+        return (
+            f"{self.prefix}0_{receiver}"
+            if self.rank == 0
+            else f"{self.prefix}{self.rank}"
+        )
+
+    def _on_message(self, topic: str, payload: bytes) -> None:
+        self.deliver(self._inflate(Message.decode(payload)))
+
+    def _deflate(self, msg: Message) -> Message:
+        return msg  # plain MQTT: whole message on the topic
+
+    def _inflate(self, msg: Message) -> Message:
+        return msg
+
+    def send_message(self, msg: Message) -> None:
+        self.bus.publish(
+            self._topic_for(msg.receiver), self._deflate(msg).encode()
+        )
+
+
+class PubSubBlobTransport(PubSubTransport):
+    """MQTT+S3-shaped: control plane on the topic bus, bulk ``model_params``
+    in the blob store (reference ``mqtt_s3_comm_manager.py:172-211`` /
+    ``:141-163``)."""
+
+    def __init__(
+        self,
+        rank: int,
+        bus: TopicBus,
+        store: BlobStore,
+        size: int,
+        topic_prefix: str = "fedml_",
+    ):
+        super().__init__(rank, bus, size, topic_prefix)
+        self.store = store
+
+    def _deflate(self, msg: Message) -> Message:
+        params = msg.get(KEY_MODEL_PARAMS)
+        if params is None:
+            return msg
+        # blob = the params subtree through the SAME wire codec (pickle-5
+        # meta + native tensor frame) as whole messages
+        carrier = Message(-1, msg.sender, msg.receiver,
+                          {KEY_MODEL_PARAMS: params})
+        key = f"{self._topic_for(msg.receiver)}_{uuid.uuid4()}"
+        url = self.store.put(key, carrier.encode())
+        payload = {
+            k: v for k, v in msg.payload.items() if k != KEY_MODEL_PARAMS
+        }
+        payload[KEY_BLOB] = key
+        payload[KEY_BLOB_URL] = url
+        return Message(msg.msg_type, msg.sender, msg.receiver, payload)
+
+    def _inflate(self, msg: Message) -> Message:
+        key = msg.get(KEY_BLOB)
+        if key is None:
+            return msg
+        carrier = Message.decode(self.store.get(key))
+        # each key is a fresh uuid with a single receiver: reclaim the blob
+        # immediately so a long run does not accumulate one model-sized
+        # object per message
+        self.store.delete(key)
+        payload = {
+            k: v
+            for k, v in msg.payload.items()
+            if k not in (KEY_BLOB, KEY_BLOB_URL)
+        }
+        payload[KEY_MODEL_PARAMS] = carrier.get(KEY_MODEL_PARAMS)
+        return Message(msg.msg_type, msg.sender, msg.receiver, payload)
