@@ -1,0 +1,164 @@
+"""Streaming data for decentralized online learning (DOL).
+
+Reference: ``fedml_api/data_preprocessing/UCI/data_loader_for_susy_and_ro.py``
+— the SUSY / Room-Occupancy CSV streams behind the decentralized online
+experiments (``fedml_experiments/standalone/decentralized/main_dol.py``).
+Each client receives a stream of ``T`` (x, y) samples, one consumed per
+iteration; a ``beta`` fraction of the stream is "adversarial" (samples
+clustered by k-means and dealt out cluster-per-client, so clients see
+non-IID drift), the rest is stochastic (shared shuffled pool).
+
+Offline stand-in: :func:`make_susy_like_stream` generates a procedural
+binary stream with the same shape/statistics (client drift + noisy linear
+concept), so the DOL algorithms and regret metric run without the UCI
+files.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+
+import numpy as np
+
+
+def _kmeans(x: np.ndarray, k: int, iters: int = 20, seed: int = 0):
+    """Tiny numpy k-means (replaces the reference's sklearn KMeans for the
+    adversarial split; zero-dependency)."""
+    rng = np.random.default_rng(seed)
+    centers = x[rng.choice(len(x), k, replace=False)]
+    assign = np.zeros(len(x), np.int64)
+    for _ in range(iters):
+        d = ((x[:, None, :] - centers[None]) ** 2).sum(-1)
+        new_assign = d.argmin(1)
+        if (new_assign == assign).all():
+            break
+        assign = new_assign
+        for c in range(k):
+            pts = x[assign == c]
+            if len(pts):
+                centers[c] = pts.mean(0)
+    return assign
+
+
+def split_stream(
+    x: np.ndarray,
+    y: np.ndarray,
+    n_clients: int,
+    iterations: int,
+    beta: float = 0.0,
+    seed: int = 0,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Deal a global sample stream into per-client streams
+    ``([N, T, d], [N, T])``: the first ``beta`` fraction adversarially
+    (k-means cluster i -> client i, reference ``load_adversarial_data``),
+    the rest stochastically (shuffled pool, reference
+    ``load_stochastic_data``). Samples recycle if the file is short."""
+    rng = np.random.default_rng(seed)
+    need = n_clients * iterations
+    if len(x) < need:  # recycle like the reference's modulo indexing
+        reps = -(-need // len(x))
+        x, y = np.tile(x, (reps, 1))[:need], np.tile(y, reps)[:need]
+    t_adv = int(beta * iterations)
+    xs = np.zeros((n_clients, iterations) + x.shape[1:], np.float32)
+    ys = np.zeros((n_clients, iterations), np.float32)
+    if t_adv > 0:
+        n_adv = n_clients * t_adv
+        xa, ya = x[:n_adv], y[:n_adv]
+        assign = _kmeans(xa, n_clients, seed=seed)
+        for c in range(n_clients):
+            rows = np.where(assign == c)[0]
+            if len(rows) == 0:
+                rows = rng.choice(n_adv, t_adv)
+            take = np.resize(rows, t_adv)
+            xs[c, :t_adv] = xa[take]
+            ys[c, :t_adv] = ya[take]
+    rest = rng.permutation(np.arange(n_clients * t_adv, len(x)))
+    need_rest = n_clients * (iterations - t_adv)
+    take = np.resize(rest, need_rest).reshape(n_clients, -1)
+    for c in range(n_clients):
+        xs[c, t_adv:] = x[take[c]]
+        ys[c, t_adv:] = y[take[c]]
+    return xs, ys
+
+
+def load_susy_csv(path: str, limit: int | None = None):
+    """SUSY.csv: label first, 18 features (reference ``preprocessing`` for
+    data_name == 'SUSY')."""
+    xs, ys = [], []
+    with open(path) as f:
+        for i, row in enumerate(csv.reader(f)):
+            if limit is not None and i >= limit:
+                break
+            ys.append(float(row[0]))
+            xs.append([float(v) for v in row[1:19]])
+    return np.asarray(xs, np.float32), np.asarray(ys, np.float32)
+
+
+def load_room_occupancy_txt(path: str, limit: int | None = None):
+    """UCI room-occupancy ``datatraining.txt``: header row, then
+    ``id,date,Temperature,Humidity,Light,CO2,HumidityRatio,Occupancy`` —
+    5 features, binary label last (reference 'RO' branch)."""
+    xs, ys = [], []
+    with open(path) as f:
+        reader = csv.reader(f)
+        next(reader)  # header
+        for i, row in enumerate(reader):
+            if limit is not None and i >= limit:
+                break
+            vals = row[-6:]  # 5 features + label
+            xs.append([float(v) for v in vals[:5]])
+            ys.append(float(vals[5]))
+    return np.asarray(xs, np.float32), np.asarray(ys, np.float32)
+
+
+def load_uci_stream(
+    name: str,
+    data_dir: str,
+    n_clients: int,
+    iterations: int,
+    beta: float = 0.0,
+    seed: int = 0,
+):
+    """Per-client streams from the UCI files (reference ``main_dol.py``
+    paths: ``SUSY/SUSY.csv`` / ``room_occupancy/datatraining.txt``)."""
+    name = name.upper()
+    limit = max(4 * n_clients * iterations, 10000)
+    if name == "SUSY":
+        path = os.path.join(data_dir, "SUSY", "SUSY.csv")
+        if not os.path.exists(path):
+            path = os.path.join(data_dir, "SUSY.csv")
+        x, y = load_susy_csv(path, limit)
+    elif name == "RO":
+        path = os.path.join(data_dir, "room_occupancy", "datatraining.txt")
+        if not os.path.exists(path):
+            path = os.path.join(data_dir, "datatraining.txt")
+        x, y = load_room_occupancy_txt(path, limit)
+    else:
+        raise ValueError(f"unknown UCI stream: {name} (SUSY | RO)")
+    # standardize features (the reference trains raw; standardizing keeps
+    # the logistic stream well-conditioned without changing the protocol)
+    x = (x - x.mean(0)) / (x.std(0) + 1e-8)
+    return split_stream(x, y, n_clients, iterations, beta, seed)
+
+
+def make_susy_like_stream(
+    n_clients: int,
+    iterations: int,
+    input_dim: int = 18,
+    beta: float = 0.0,
+    drift: float = 0.3,
+    seed: int = 0,
+):
+    """Procedural SUSY-shaped stream (offline stand-in): a shared noisy
+    linear concept plus per-client feature drift, so online learners have
+    a decreasing-regret signal and beta-clustering has structure."""
+    rng = np.random.default_rng(seed)
+    w = rng.normal(size=(input_dim,))
+    centers = rng.normal(size=(n_clients, input_dim)) * drift
+    n = n_clients * iterations * 2
+    x = rng.normal(size=(n, input_dim)).astype(np.float32)
+    x += centers[rng.integers(0, n_clients, n)]
+    logits = x @ w + rng.normal(scale=0.5, size=n)
+    y = (logits > 0).astype(np.float32)
+    return split_stream(x, y, n_clients, iterations, beta, seed)
