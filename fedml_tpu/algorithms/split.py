@@ -137,7 +137,7 @@ class FedGKTSim:
             _, logits, new_vars = self._client_apply_train(variables, xb)
             ce = optax.softmax_cross_entropy_with_integer_labels(logits, yb)
             ce = jnp.sum(ce * wb) / jnp.maximum(jnp.sum(wb), 1.0)
-            kd = kl_temperature(logits, tb, self.T)
+            kd = kl_temperature(logits, tb, self.T, wb)
             loss = ce + jnp.where(use_kd, self.alpha, 0.0) * kd
             return loss, new_vars
 
@@ -199,60 +199,23 @@ class FedGKTSim:
             state.server_logits, state.has_server_logits, ckeys,
         )
 
-        # 2. feature/logit extraction for every client's samples, written
-        #    into global [N_total, ...] banks via the index maps
-        eval_bs = self.batch_size
-
-        def extract_client(c_vars, idx_row, mask_row):
-            steps = self.max_n // eval_bs
-
-            def body(_, s):
-                take = jax.lax.dynamic_slice_in_dim(
-                    idx_row, s * eval_bs, eval_bs
-                )
-                xb = jnp.take(arrays.x, take, axis=0)
-                f, lg = self._client_apply_eval(c_vars, xb)
-                return None, (f, lg)
-
-            _, (feats, logits) = jax.lax.scan(body, None, jnp.arange(steps))
-            return (
-                feats.reshape((self.max_n,) + feats.shape[2:]),
-                logits.reshape((self.max_n, -1)),
-            )
-
-        feats_all, logits_all = jax.vmap(extract_client, in_axes=(0, 0, 0))(
-            client_stack, arrays.idx, arrays.mask
-        )  # [N, max_n, ...]
-
-        flat_idx = arrays.idx.reshape(-1)
-        flat_mask = arrays.mask.reshape(-1)
-        # padded rows all carry index 0; route them to a scratch slot at
-        # position n_total so they can never clobber sample 0's features
-        safe_idx = jnp.where(
-            flat_mask > 0, flat_idx, self.n_total
-        ).astype(jnp.int32)
-        feat_bank = jnp.zeros(
-            (self.n_total + 1,) + feats_all.shape[2:], feats_all.dtype
-        )
-        feat_bank = feat_bank.at[safe_idx].set(
-            feats_all.reshape((-1,) + feats_all.shape[2:])
-        )[: self.n_total]
-        cl_bank = jnp.zeros((self.n_total + 1, self.num_classes))
-        cl_bank = cl_bank.at[safe_idx].set(
-            logits_all.reshape((-1, self.num_classes))
-        )[: self.n_total]
-
-        # 3. server training over the whole feature bank
-        #    (GKTServerTrainer.train_and_eval: epochs over all clients'
-        #    batches; loss = KL + alpha*CE, :255-263)
+        # 2+3. server training, streaming client-by-client. The reference
+        #    banks every client's feature maps host-side and iterates
+        #    client-per-epoch (``GKTServerTrainer.train_and_eval``); a
+        #    device-resident [N_total, H, W, C] bank is ~GBs of HBM at
+        #    CIFAR/ResNet scale, so instead the server RECOMPUTES each
+        #    batch's features from the (frozen, post-phase-1) edge model —
+        #    HBM stays bounded by one batch, and the extra stem forward is
+        #    tiny next to the Bottleneck-trunk fwd+bwd.
+        #    Loss = KL(teacher=client logits) + alpha*CE
+        #    (``GKTServerTrainer.py:48-49,255-263``).
         s_bs = self.batch_size
-        pad = (-self.n_total) % s_bs
-        n_srv = self.n_total + pad
+        s_steps = self.max_n // s_bs
 
         def s_loss_fn(params, static, fb, yb, tb, wb):
             variables = {**static, "params": params}
             out, new_vars = self._server_apply_train(variables, fb)
-            kd = kl_temperature(out, tb, self.T)
+            kd = kl_temperature(out, tb, self.T, wb)
             ce = optax.softmax_cross_entropy_with_integer_labels(out, yb)
             ce = jnp.sum(ce * wb) / jnp.maximum(jnp.sum(wb), 1.0)
             return kd + self.alpha * ce, new_vars
@@ -260,17 +223,23 @@ class FedGKTSim:
         s_grad = jax.value_and_grad(s_loss_fn, has_aux=True)
         skey = jax.random.fold_in(rkey, 0x5EAF)
 
-        def s_epoch(carry, ekey):
+        def s_client_pass(carry, inputs):
+            """One client's epoch slice of server training: recompute the
+            client's features batch-by-batch, server grad step on each."""
             variables, opt_state = carry
-            perm = jax.random.permutation(ekey, n_srv) % self.n_total
+            c_vars, idx_row, mask_row, ckey = inputs
+            perm = jax.random.permutation(ckey, self.max_n)
+            order = jnp.argsort(1.0 - mask_row[perm], stable=True)
+            perm = perm[order]
 
             def step(carry2, s):
                 variables, opt_state = carry2
                 take = jax.lax.dynamic_slice_in_dim(perm, s * s_bs, s_bs)
-                fb = jnp.take(feat_bank, take, axis=0)
-                yb = jnp.take(arrays.y, take, axis=0)
-                tb = jnp.take(cl_bank, take, axis=0)
-                wb = jnp.ones((s_bs,))
+                b_idx = idx_row[take]
+                wb = mask_row[take]
+                xb = jnp.take(arrays.x, b_idx, axis=0)
+                yb = jnp.take(arrays.y, b_idx, axis=0)
+                fb, tb = self._client_apply_eval(c_vars, xb)
                 params = variables["params"]
                 static = {
                     k: v for k, v in variables.items() if k != "params"
@@ -283,12 +252,26 @@ class FedGKTSim:
                     **new_vars,
                     "params": optax.apply_updates(params, updates),
                 }
-                return (new_vars, new_os), None
+                valid = jnp.sum(wb) > 0
+                sel = lambda a, b: jax.tree.map(
+                    lambda p, q: jnp.where(valid, p, q), a, b
+                )
+                return (sel(new_vars, variables), sel(new_os, opt_state)), None
 
             carry2, _ = jax.lax.scan(
-                step, (variables, opt_state), jnp.arange(n_srv // s_bs)
+                step, (variables, opt_state), jnp.arange(s_steps)
             )
             return carry2, None
+
+        def s_epoch(carry, ekey):
+            ckeys_e = jax.vmap(lambda c: jax.random.fold_in(ekey, c))(
+                jnp.arange(n)
+            )
+            carry, _ = jax.lax.scan(
+                s_client_pass, carry,
+                (client_stack, arrays.idx, arrays.mask, ckeys_e),
+            )
+            return carry, None
 
         ekeys = jax.vmap(lambda e: jax.random.fold_in(skey, e))(
             jnp.arange(self.cfg.train.epochs)
@@ -298,21 +281,34 @@ class FedGKTSim:
         )
 
         # 4. server logits back to clients (GKTServerTrainer
-        #    get_global_logits) — scan, not an unrolled python loop, so the
-        #    compiled program size is independent of dataset size
-        fb_padded = jnp.concatenate(
-            [feat_bank,
-             jnp.zeros((pad,) + feat_bank.shape[1:], feat_bank.dtype)]
-        )
+        #    get_global_logits): recompute features per client batch and
+        #    scatter logits into the [N_total, K] bank (small: K floats per
+        #    sample). Padded rows route to a scratch slot.
+        def srv_logits_client(bank, inputs):
+            c_vars, idx_row, mask_row = inputs
 
-        def srv_logits(_, s):
-            fb = jax.lax.dynamic_slice_in_dim(fb_padded, s * s_bs, s_bs)
-            return None, self._server_apply_eval(server_vars, fb)
+            def body(bank, s):
+                take = jax.lax.dynamic_slice_in_dim(
+                    idx_row, s * s_bs, s_bs
+                )
+                wb = jax.lax.dynamic_slice_in_dim(mask_row, s * s_bs, s_bs)
+                xb = jnp.take(arrays.x, take, axis=0)
+                fb, _ = self._client_apply_eval(c_vars, xb)
+                out = self._server_apply_eval(server_vars, fb)
+                safe = jnp.where(wb > 0, take, self.n_total).astype(
+                    jnp.int32
+                )
+                return bank.at[safe].set(out), None
 
-        _, parts = jax.lax.scan(
-            srv_logits, None, jnp.arange(n_srv // s_bs)
+            bank, _ = jax.lax.scan(body, bank, jnp.arange(s_steps))
+            return bank, None
+
+        bank0 = jnp.zeros((self.n_total + 1, self.num_classes))
+        bank, _ = jax.lax.scan(
+            srv_logits_client, bank0,
+            (client_stack, arrays.idx, arrays.mask),
         )
-        new_server_logits = parts.reshape(n_srv, -1)[: self.n_total]
+        new_server_logits = bank[: self.n_total]
 
         return (
             FedGKTState(

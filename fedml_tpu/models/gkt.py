@@ -1,14 +1,19 @@
 """Split models for Group Knowledge Transfer (FedGKT) and SplitNN.
 
-Reference: ``fedml_api/model/cv/resnet56_gkt/`` — the ResNet-56 is cut
-after the first residual stage: the client (edge) model is conv1 + stage-1
-blocks and a small classifier head over the 16-channel feature maps
-(``resnet_client.py:112``), the server model is stages 2-3 + the final head,
-consuming the client's feature maps (``resnet_server.py:113``).
+Reference: ``fedml_api/model/cv/resnet56_gkt/`` — the ResNet-56 is cut at
+the STEM: the client (edge) model ``resnet8_56`` is conv1+bn+relu (whose
+output IS the exchanged feature map, ``resnet_client.py:190-203``:
+``extracted_features = x`` right after the stem) followed by 2 Bottleneck
+blocks at planes 16 and an fc over 16*4 channels; the server model
+``resnet56_server`` is the Bottleneck [6,6,6] trunk minus the stem
+(``resnet_server.py:186-198``), consuming the client's 16-channel feature
+maps and classifying from 64*4 channels.
 
-TPU notes: NHWC, BasicBlocks identical to the main zoo's ResNet; the split
-boundary tensor is ``[B, 32, 32, 16]`` for CIFAR shapes — contiguous and
-cheap to ship across a mesh/DCN boundary.
+TPU notes: NHWC; the split boundary tensor is ``[B, 32, 32, 16]`` for
+CIFAR shapes — contiguous and cheap to ship across a mesh/DCN boundary.
+Submodules carry explicit torch-style names (conv1/bn1/layer{i}_{b}/fc) so
+the reference's pretrained checkpoints (``resnet56/best.pth``) can be
+mapped in (:func:`load_torch_gkt_state`).
 """
 
 from __future__ import annotations
@@ -16,45 +21,82 @@ from __future__ import annotations
 from typing import Sequence
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
+import numpy as np
 
-from fedml_tpu.models.vision import BasicBlock
 
 
-class GKTClientResNet(nn.Module):
-    """Edge-side model: stem + one stage of BasicBlocks; returns
-    ``(features, logits)`` (reference ``resnet_client.py`` forward returns
-    ``(extracted_features, logits)``)."""
+class Bottleneck(nn.Module):
+    """CIFAR Bottleneck (reference ``resnet_client.py:69-110``):
+    1x1 reduce -> 3x3 (stride) -> 1x1 expand (x4), BN after each, projection
+    shortcut when shape changes."""
 
-    num_classes: int = 10
-    num_blocks: int = 3  # reference resnet8_56: 3 blocks client-side
-    width: int = 16
-    norm: str = "bn"
+    planes: int
+    stride: int = 1
+    expansion: int = 4
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        h = nn.Conv(self.width, (3, 3), padding="SAME", use_bias=False)(x)
-        h = nn.BatchNorm(use_running_average=not train)(h)
+        out_ch = self.planes * self.expansion
+        bn = lambda name: nn.BatchNorm(
+            use_running_average=not train, name=name
+        )
+        h = nn.Conv(self.planes, (1, 1), use_bias=False, name="conv1")(x)
+        h = nn.relu(bn("bn1")(h))
+        h = nn.Conv(
+            self.planes, (3, 3), strides=(self.stride, self.stride),
+            padding="SAME", use_bias=False, name="conv2",
+        )(h)
+        h = nn.relu(bn("bn2")(h))
+        h = nn.Conv(out_ch, (1, 1), use_bias=False, name="conv3")(h)
+        h = bn("bn3")(h)
+        identity = x
+        if self.stride != 1 or x.shape[-1] != out_ch:
+            identity = nn.Conv(
+                out_ch, (1, 1), strides=(self.stride, self.stride),
+                use_bias=False, name="downsample_conv",
+            )(x)
+            identity = bn("downsample_bn")(identity)
+        return nn.relu(h + identity)
+
+
+class GKTClientResNet(nn.Module):
+    """Edge-side ``resnet8_56`` (reference ``resnet_client.py:230-238``:
+    ResNet(Bottleneck, [2, 2, 2]) with only layer1 active): stem ->
+    *features* (the exchanged tensor, post-stem), then 2 Bottlenecks at
+    planes 16 -> avgpool -> fc. Returns ``(features, logits)``."""
+
+    num_classes: int = 10
+    num_blocks: int = 2
+    width: int = 16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = nn.Conv(
+            self.width, (3, 3), padding="SAME", use_bias=False, name="conv1"
+        )(x)
+        h = nn.BatchNorm(use_running_average=not train, name="bn1")(h)
         h = nn.relu(h)
-        for _ in range(self.num_blocks):
-            h = BasicBlock(self.width, stride=1, norm=self.norm)(
+        features = h  # [B, H, W, 16] — the split-boundary tensor
+        for b in range(self.num_blocks):
+            h = Bottleneck(self.width, stride=1, name=f"layer1_{b}")(
                 h, train=train
             )
-        features = h  # [B, H, W, width]
         pooled = jnp.mean(h, axis=(1, 2))
-        logits = nn.Dense(self.num_classes, name="head")(pooled)
+        logits = nn.Dense(self.num_classes, name="fc")(pooled)
         return features, logits
 
 
 class GKTServerResNet(nn.Module):
-    """Server-side model over client feature maps: stages 2-3 of the
-    CIFAR ResNet + head (reference ``resnet_server.py:113``,
-    ``resnet56_server`` = remaining 2x9 blocks at widths 32/64)."""
+    """Server-side ``resnet56_server`` (reference
+    ``resnet_server.py:200-208``: ResNet(Bottleneck, [6, 6, 6]) minus the
+    stem): three Bottleneck stages at planes (16, 32, 64), strides
+    (1, 2, 2), over the client's post-stem feature maps; fc over 64*4."""
 
     num_classes: int = 10
-    blocks_per_stage: Sequence[int] = (9, 9)
-    widths: Sequence[int] = (32, 64)
-    norm: str = "bn"
+    blocks_per_stage: Sequence[int] = (6, 6, 6)
+    widths: Sequence[int] = (16, 32, 64)
 
     @nn.compact
     def __call__(self, features, train: bool = False):
@@ -63,11 +105,77 @@ class GKTServerResNet(nn.Module):
             zip(self.blocks_per_stage, self.widths)
         ):
             for b in range(n):
-                h = BasicBlock(w, stride=2 if b == 0 else 1, norm=self.norm)(
-                    h, train=train
-                )
+                stride = 2 if (stage > 0 and b == 0) else 1
+                h = Bottleneck(
+                    w, stride=stride, name=f"layer{stage + 1}_{b}"
+                )(h, train=train)
         h = jnp.mean(h, axis=(1, 2))
-        return nn.Dense(self.num_classes, name="head")(h)
+        return nn.Dense(self.num_classes, name="fc")(h)
+
+
+def load_torch_gkt_state(path: str, variables, side: str = "server"):
+    """Warm-start from the reference's pretrained torch checkpoint
+    (``fedml_api/model/cv/pretrained/CIFAR10/resnet56/best.pth``, consumed
+    by ``resnet56_server``/``resnet8_56`` via ``pretrained=True``).
+
+    Maps the torch ``state_dict`` (``conv1.weight``, ``bn1.*``,
+    ``layer{i}.{b}.conv{j}.weight`` / ``bn{j}.*`` / ``downsample.{0,1}.*``,
+    ``fc.*``) onto this module's explicitly-named flax tree. Missing keys
+    keep their current (fresh) values; returns the updated variables."""
+    import torch
+
+    ckpt = torch.load(path, map_location="cpu", weights_only=False)
+    sd = ckpt.get("state_dict", ckpt)
+    sd = {k.replace("module.", ""): v.numpy() for k, v in sd.items()}
+
+    params = jax.tree_util.tree_map(lambda v: v, variables["params"])
+    stats = jax.tree_util.tree_map(
+        lambda v: v, variables.get("batch_stats", {})
+    )
+
+    def put_conv(dst, torch_key):
+        if torch_key in sd:
+            w = sd[torch_key]  # [O, I, kh, kw] -> [kh, kw, I, O]
+            dst["kernel"] = np.transpose(w, (2, 3, 1, 0)).astype(np.float32)
+
+    def put_bn(pdst, sdst, prefix):
+        if f"{prefix}.weight" in sd:
+            pdst["scale"] = sd[f"{prefix}.weight"].astype(np.float32)
+            pdst["bias"] = sd[f"{prefix}.bias"].astype(np.float32)
+            sdst["mean"] = sd[f"{prefix}.running_mean"].astype(np.float32)
+            sdst["var"] = sd[f"{prefix}.running_var"].astype(np.float32)
+
+    def put_dense(dst, prefix):
+        if f"{prefix}.weight" in sd:
+            dst["kernel"] = sd[f"{prefix}.weight"].T.astype(np.float32)
+            dst["bias"] = sd[f"{prefix}.bias"].astype(np.float32)
+
+    if side == "client" and "conv1" in params:
+        put_conv(params["conv1"], "conv1.weight")
+        put_bn(params["bn1"], stats["bn1"], "bn1")
+    for name in list(params.keys()):
+        if not name.startswith("layer"):
+            continue
+        stage_blk = name[len("layer"):]  # "{i}_{b}"
+        i, b = stage_blk.split("_")
+        tprefix = f"layer{i}.{b}"
+        blk_p, blk_s = params[name], stats.get(name, {})
+        for j in (1, 2, 3):
+            put_conv(blk_p[f"conv{j}"], f"{tprefix}.conv{j}.weight")
+            put_bn(blk_p[f"bn{j}"], blk_s[f"bn{j}"], f"{tprefix}.bn{j}")
+        if "downsample_conv" in blk_p:
+            put_conv(blk_p["downsample_conv"], f"{tprefix}.downsample.0.weight")
+            put_bn(
+                blk_p["downsample_bn"], blk_s["downsample_bn"],
+                f"{tprefix}.downsample.1",
+            )
+    put_dense(params["fc"], "fc")
+    out = dict(variables)
+    out["params"] = params
+    if stats:
+        out["batch_stats"] = stats
+    return out
+
 
 
 class SplitClientNet(nn.Module):

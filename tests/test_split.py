@@ -22,6 +22,7 @@ from fedml_tpu.config import (
     TrainConfig,
 )
 from fedml_tpu.data.loaders import make_fake_image_dataset
+from fedml_tpu.data.loaders import load_dataset
 from fedml_tpu.models.gkt import (
     GKTClientResNet,
     GKTServerResNet,
@@ -171,3 +172,82 @@ def test_vfl_two_party():
     ev = sim.evaluate(state)
     assert ev["test_acc"] > 0.7, ev
     assert ev["test_auc"] > 0.7, ev
+
+
+@pytest.mark.slow
+def test_fedgkt_faithful_resnet56_split_shapes():
+    """One round with the REAL split architecture (resnet8_56 client:
+    stem-cut features + 2 Bottlenecks; resnet56_server: Bottleneck [6,6,6])
+    on CIFAR shapes — the server never materializes a feature bank, HBM is
+    bounded by one batch."""
+    cfg = ExperimentConfig(
+        data=DataConfig(dataset="fake_cifar10", num_clients=2, batch_size=8,
+                        seed=0, dataset_r=0.01),
+        model=ModelConfig(name="resnet56", num_classes=10,
+                          input_shape=(32, 32, 3)),
+        train=TrainConfig(lr=0.05, epochs=1),
+        fed=FedConfig(num_rounds=1, clients_per_round=2, eval_every=1),
+        seed=0,
+    )
+    data = load_dataset(cfg.data)
+    sim = FedGKTSim(
+        GKTClientResNet(num_classes=10),
+        GKTServerResNet(num_classes=10),
+        data, cfg,
+    )
+    state = sim.init()
+    state, _ = sim.run_round(state)
+    m = sim.evaluate(state)
+    assert 0.0 <= m["test_acc"] <= 1.0
+    # split boundary is the post-stem 16-channel map
+    c0 = jax.tree.map(lambda s: s[0], state.client_stack)
+    f, lg = sim._client_apply_eval(c0, jnp.zeros((2, 32, 32, 3)))
+    assert f.shape == (2, 32, 32, 16)
+    assert lg.shape == (2, 10)
+
+
+def test_gkt_pretrained_torch_mapping(tmp_path):
+    """The reference's pretrained resnet56 checkpoint warm-starts the
+    server (resnet56_gkt pretrained=True path)."""
+    import torch
+
+    from fedml_tpu.models.gkt import load_torch_gkt_state
+
+    s = GKTServerResNet(num_classes=10, blocks_per_stage=(1, 1),
+                        widths=(8, 16))
+    sv = s.init({"params": jax.random.key(0)},
+                jnp.zeros((1, 8, 8, 16)), train=False)
+    sd = {}
+    # layer1.0: in 16 -> planes 8 (out 32); layer2.0: in 32 -> planes 16
+    specs = [("layer1.0", 16, 8), ("layer2.0", 32, 16)]
+    g = torch.Generator().manual_seed(0)
+    for pre, cin, p in specs:
+        sd[f"{pre}.conv1.weight"] = torch.randn(p, cin, 1, 1, generator=g)
+        sd[f"{pre}.conv2.weight"] = torch.randn(p, p, 3, 3, generator=g)
+        sd[f"{pre}.conv3.weight"] = torch.randn(p * 4, p, 1, 1, generator=g)
+        for j, ch in (("1", p), ("2", p), ("3", p * 4)):
+            sd[f"{pre}.bn{j}.weight"] = torch.ones(ch)
+            sd[f"{pre}.bn{j}.bias"] = torch.zeros(ch)
+            sd[f"{pre}.bn{j}.running_mean"] = torch.zeros(ch)
+            sd[f"{pre}.bn{j}.running_var"] = torch.ones(ch)
+        sd[f"{pre}.downsample.0.weight"] = torch.randn(p * 4, cin, 1, 1,
+                                                       generator=g)
+        sd[f"{pre}.downsample.1.weight"] = torch.ones(p * 4)
+        sd[f"{pre}.downsample.1.bias"] = torch.zeros(p * 4)
+        sd[f"{pre}.downsample.1.running_mean"] = torch.zeros(p * 4)
+        sd[f"{pre}.downsample.1.running_var"] = torch.ones(p * 4)
+    sd["fc.weight"] = torch.randn(10, 64, generator=g)
+    sd["fc.bias"] = torch.zeros(10)
+    path = tmp_path / "best.pth"
+    torch.save({"state_dict": sd}, path)
+    sv2 = load_torch_gkt_state(str(path), sv, side="server")
+    np.testing.assert_allclose(
+        np.asarray(sv2["params"]["layer1_0"]["conv2"]["kernel"]),
+        np.transpose(sd["layer1.0.conv2.weight"].numpy(), (2, 3, 1, 0)),
+    )
+    np.testing.assert_allclose(
+        np.asarray(sv2["params"]["fc"]["kernel"]),
+        sd["fc.weight"].numpy().T,
+    )
+    out = s.apply(sv2, jnp.zeros((2, 8, 8, 16)), train=False)
+    assert out.shape == (2, 10)
