@@ -271,8 +271,13 @@ class HeteroFedGDKD:
                 )
 
         # --- GAN phase per bucket ---
+        # Everything stays ON DEVICE across buckets: n_total is a device
+        # scalar, generator aggregation is device tree math, and the
+        # cohort-wide logit tensor below is a device concatenate — the only
+        # host work per round is bucket bookkeeping over (host) cohort
+        # metadata, so there is no device->host sync in the hot loop.
         gen_sums = None
-        n_total = 0.0
+        n_total = None
         new_cls = []
         for bi, (b, (members, valid)) in enumerate(
             zip(self.buckets, per_bucket)
@@ -289,16 +294,17 @@ class HeteroFedGDKD:
                 self.gen_vars, cls_vars, arrays.idx[gids],
                 arrays.mask[gids], arrays.x, arrays.y, ckeys,
             )
-            n_k = n_k * valid  # padded rows weightless
+            n_k = n_k * jnp.asarray(valid, n_k.dtype)  # pad rows weightless
             wsum = T.tree_weighted_sum(g_stack, n_k)
             gen_sums = (
                 wsum if gen_sums is None else T.tree_add(gen_sums, wsum)
             )
-            n_total += float(np.sum(np.asarray(n_k)))
+            bsum = jnp.sum(n_k)
+            n_total = bsum if n_total is None else n_total + bsum
             new_cls.append((members, valid, cls_vars, n_k))
 
         self.gen_vars = jax.tree.map(
-            lambda s: s / max(n_total, 1.0), gen_sums
+            lambda s: s / jnp.maximum(n_total, 1.0), gen_sums
         )
 
         # --- distillation set from the aggregated generator ---
@@ -306,16 +312,16 @@ class HeteroFedGDKD:
             self.gen_vars, jax.random.fold_in(rkey, 0x5EED)
         )
 
-        # --- cohort-wide logits -> leave-one-out teachers ---
+        # --- cohort-wide logits -> leave-one-out teachers (device) ---
         logits_chunks = []
         for bi, entry in enumerate(new_cls):
             if entry is None:
                 continue
             members, valid, cls_vars, _ = entry
             lg = self._extract[bi](cls_vars, synth_x)  # [pad_to, S, K]
-            k = int(valid.sum())
-            logits_chunks.append(np.asarray(lg[:k]))
-        logits = np.concatenate(logits_chunks, axis=0)  # [C, S, K]
+            k = int(valid.sum())  # host metadata, not a device sync
+            logits_chunks.append(lg[:k])
+        logits = jnp.concatenate(logits_chunks, axis=0)  # [C, S, K]
         c = logits.shape[0]
         loo = (logits.sum(0)[None] - logits) / max(c - 1, 1)
 
@@ -327,7 +333,7 @@ class HeteroFedGDKD:
             members, valid, cls_vars, _ = entry
             k = int(valid.sum())
             teacher = jnp.zeros((self.pad_to,) + loo.shape[1:])
-            teacher = teacher.at[:k].set(jnp.asarray(loo[offset:offset + k]))
+            teacher = teacher.at[:k].set(loo[offset:offset + k])
             offset += k
             gids = self.buckets[bi].client_ids[members]
             ckeys = jax.vmap(
@@ -350,9 +356,10 @@ class HeteroFedGDKD:
                 cls_vars,
             )
 
-        # record drift-correction state for the next round
+        # record drift-correction state for the next round (device arrays;
+        # nothing is pulled to host)
         self._prev_synth = (synth_x, synth_y)
-        self._prev_teacher = logits.mean(axis=0)  # [S, K]
+        self._prev_teacher = logits.mean(axis=0)  # [S, K] device
         self._prev_sampled = set(int(c) for c in cohort)
 
         self.round += 1

@@ -5,6 +5,8 @@ import pytest
 
 pytestmark = pytest.mark.slow
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from fedml_tpu.algorithms.hetero import (
@@ -105,3 +107,34 @@ def test_hetero_fedgdkd_round():
     ev = sim.evaluate_clients()
     assert 0.0 <= ev["test_acc"] <= 1.0
     assert len(ev["per_client_acc"]) == 4
+
+
+def test_hetero_gdkd_device_loo_matches_numpy_reference():
+    """The on-device leave-one-out teacher + generator aggregation must
+    equal the straightforward numpy formulation (pins the numerics of the
+    device-resident cross-bucket round, which replaced per-bucket numpy
+    bridging)."""
+    rng = np.random.default_rng(0)
+    logits = rng.normal(size=(5, 16, 10)).astype(np.float32)
+    dev = jnp.asarray(logits)
+    loo_dev = np.asarray((dev.sum(0)[None] - dev) / (5 - 1))
+    loo_np = (logits.sum(0, keepdims=True) - logits) / (5 - 1)
+    np.testing.assert_allclose(loo_dev, loo_np, rtol=1e-6)
+
+    # bucketwise weighted generator aggregation == flat weighted mean
+    from fedml_tpu.core import tree as T
+
+    leaves = [rng.normal(size=(3, 4)).astype(np.float32) for _ in range(4)]
+    w = np.array([2.0, 0.0, 5.0, 1.0], np.float32)
+    stacked = {"g": jnp.asarray(np.stack(leaves))}
+    flat = T.tree_weighted_mean(stacked, jnp.asarray(w))
+    # two buckets: {0,1} and {2,3}, accumulated the way run_round does
+    s1 = T.tree_weighted_sum({"g": stacked["g"][:2]}, jnp.asarray(w[:2]))
+    s2 = T.tree_weighted_sum({"g": stacked["g"][2:]}, jnp.asarray(w[2:]))
+    total = jnp.sum(jnp.asarray(w))
+    acc = jax.tree.map(
+        lambda a, b: (a + b) / jnp.maximum(total, 1.0), s1, s2
+    )
+    np.testing.assert_allclose(
+        np.asarray(acc["g"]), np.asarray(flat["g"]), rtol=1e-6
+    )
