@@ -106,3 +106,57 @@ class FIDScorer:
         mu1, s1 = activation_statistics(self._features(images_real))
         mu2, s2 = activation_statistics(self._features(images_fake))
         return frechet_distance(mu1, s1, mu2, s2)
+
+
+class TorchScriptEmbed:
+    """Real-InceptionV3 (or any) feature extractor from a TorchScript file.
+
+    The reference scores FID with torchvision's pretrained InceptionV3
+    (``FID/InceptionV3.py``); its weights are not shipped offline. When a
+    scripted module IS available on disk (e.g. exported once with
+    ``torch.jit.script(torchvision...inception_v3(...))``), this hook runs
+    it on CPU via ``torch.jit.load`` — no torchvision dependency — making
+    the resulting FID numbers comparable to published values.
+
+    Input convention: NHWC float in [0, 1]; converted to NCHW, resized by
+    nearest-neighbor to ``input_hw``, grayscale replicated to 3 channels.
+    """
+
+    def __init__(self, path: str, input_hw: int = 299):
+        import torch
+
+        self.torch = torch
+        self.module = torch.jit.load(path, map_location="cpu").eval()
+        self.input_hw = input_hw
+
+    def __call__(self, x) -> np.ndarray:
+        torch = self.torch
+        arr = np.asarray(x, np.float32)
+        if arr.shape[-1] == 1:
+            arr = np.repeat(arr, 3, axis=-1)
+        t = torch.from_numpy(np.transpose(arr, (0, 3, 1, 2)))
+        if t.shape[-1] != self.input_hw:
+            t = torch.nn.functional.interpolate(
+                t, size=(self.input_hw, self.input_hw), mode="nearest"
+            )
+        with torch.no_grad():
+            out = self.module(t)
+        if isinstance(out, (tuple, list)):
+            out = out[0]
+        out = out.reshape(out.shape[0], -1)
+        return out.numpy()
+
+
+def make_fid_scorer(
+    inception_path: str | None = None, batch_size: int = 64
+) -> FIDScorer:
+    """FIDScorer factory: uses the real (TorchScript) Inception embed when a
+    weights file is present, otherwise the offline random-projection embed.
+    ``inception_path`` defaults to ``$FEDML_TPU_INCEPTION`` if set."""
+    import os
+
+    path = inception_path or os.environ.get("FEDML_TPU_INCEPTION")
+    if path and os.path.exists(path):
+        return FIDScorer(embed_fn=TorchScriptEmbed(path),
+                         batch_size=batch_size)
+    return FIDScorer(batch_size=batch_size)

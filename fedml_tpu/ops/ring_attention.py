@@ -117,10 +117,15 @@ def ring_attention(
     l0 = jnp.zeros((b, h, t_local), jnp.float32)
     # newer shard_map tracks varying-manual-axes: literal-initialized
     # carries must be marked as varying over the ring axis or the loop
-    # carry types mismatch
-    pvary = getattr(jax.lax, "pvary", None)
-    if pvary is not None:
-        o0, m0, l0 = (pvary(a, (axis_name,)) for a in (o0, m0, l0))
+    # carry types mismatch. jax.lax.pcast(to='varying') is the current
+    # spelling; fall back to the deprecated pvary on older jax.
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is not None:
+        mark = lambda a: pcast(a, (axis_name,), to="varying")
+    else:
+        pvary = getattr(jax.lax, "pvary", None)
+        mark = (lambda a: pvary(a, (axis_name,))) if pvary else (lambda a: a)
+    o0, m0, l0 = (mark(a) for a in (o0, m0, l0))
     o, m, l, _, _ = jax.lax.fori_loop(
         0, p, body, (o0, m0, l0, k.astype(jnp.float32), v.astype(jnp.float32))
     )

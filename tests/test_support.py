@@ -5,6 +5,7 @@ import os
 import subprocess
 import sys
 
+import jax.numpy as jnp
 import numpy as np
 
 from fedml_tpu.core.mlops import MLOpsLogger, SysStats
@@ -167,3 +168,60 @@ def test_cli_parse_args():
     assert cfg.model.input_shape == (60,)
     assert cfg.train.lr == 0.1
     assert reps == 2
+
+
+def test_per_client_observability_sink():
+    """Per-client Acc/Loss + confusion matrices + label distributions land
+    in the sink with reference-shaped keys (parity with
+    HeterogeneousModelBaseTrainerAPI._local_test_on_all_clients)."""
+    import jax
+
+    from fedml_tpu.config import DataConfig, ModelConfig
+    from fedml_tpu.data.loaders import load_dataset
+    from fedml_tpu.metrics.observability import (
+        build_per_client_eval,
+        label_distribution,
+        log_per_client_observability,
+    )
+    from fedml_tpu.metrics.sink import MetricsSink
+    from fedml_tpu.models import create_model
+
+    data = load_dataset(
+        DataConfig(dataset="fake_mnist", num_clients=3, batch_size=16,
+                   seed=0)
+    )
+    arrays = data.to_arrays(pad_multiple=16)
+    model = create_model(
+        ModelConfig(name="lr", num_classes=10, input_shape=(28, 28, 1))
+    )
+    variables = model.init(jax.random.key(0))
+    sink = MetricsSink()
+    rec = log_per_client_observability(sink, model, variables, arrays, 0)
+    for i in range(3):
+        assert f"Client {i}/Test/Acc" in rec
+        assert f"Client {i}/Train/Loss" in rec
+    assert "Train/Acc" in rec and "Test/Acc" in rec
+    cm = np.asarray(rec["confusion_test"])
+    assert cm.shape == (3, 10, 10)
+    # confusion rows sum to the per-client true test counts
+    ev = build_per_client_eval(model, 10)
+    test = ev(variables, arrays.test_x, arrays.test_y, arrays.test_idx,
+              arrays.test_mask)
+    np.testing.assert_allclose(cm.sum(axis=(1, 2)),
+                               np.asarray(test["count"]), rtol=1e-6)
+    ld = np.asarray(rec["label_distribution"])
+    assert ld.shape == (3, 10)
+    # label counts match the true per-client partition sizes
+    np.testing.assert_allclose(
+        ld.sum(1),
+        [len(data.train_idx_map[i]) for i in range(3)],
+    )
+    # stacked (personalized) variables path
+    stack = jax.tree.map(
+        lambda l: jnp.broadcast_to(l[None], (3,) + l.shape), variables
+    )
+    ev_s = build_per_client_eval(model, 10, stacked=True)
+    out = ev_s(stack, arrays.test_x, arrays.test_y, arrays.test_idx,
+               arrays.test_mask)
+    np.testing.assert_allclose(np.asarray(out["acc"]),
+                               np.asarray(test["acc"]), rtol=1e-6)
