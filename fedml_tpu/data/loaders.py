@@ -541,6 +541,16 @@ def load_dataset(cfg: DataConfig) -> FederatedData:
         from fedml_tpu.data.natural import load_stackoverflow_lr
 
         return load_stackoverflow_lr(cfg.data_dir)
+    if name in ("imagenet", "ilsvrc2012"):
+        from fedml_tpu.data.largescale import load_imagenet
+
+        return load_imagenet(cfg.data_dir, client_number=cfg.num_clients)
+    if name in ("gld23k", "gld160k", "landmarks"):
+        from fedml_tpu.data.largescale import load_landmarks
+
+        return load_landmarks(
+            cfg.data_dir, split="gld160k" if name == "gld160k" else "gld23k"
+        )
     if name == "mnist":
         x_tr, y_tr, x_te, y_te, nc = load_mnist_arrays(cfg.data_dir)
     elif name in ("cifar10", "cifar100"):

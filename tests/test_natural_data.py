@@ -306,3 +306,219 @@ def test_real_text_datasets_via_dispatch(tmp_path):
         DataConfig(dataset="fed_shakespeare", data_dir=str(tmp_path))
     )
     assert data.task == "nwp" and data.num_clients == 1
+
+
+def test_imagenet_by_class_partition(tmp_path):
+    """ImageNet federated partition: classes dealt to clients in sorted
+    order (reference load_partition_data_ImageNet:235-243)."""
+    from PIL import Image
+
+    from fedml_tpu.data.largescale import load_imagenet
+
+    rng = np.random.default_rng(0)
+    for split, n in (("train", 3), ("val", 1)):
+        for c in ("n01440764", "n01443537", "n01484850", "n01491361"):
+            d = tmp_path / split / c
+            d.mkdir(parents=True)
+            for i in range(n):
+                Image.fromarray(
+                    rng.integers(0, 255, (8, 8, 3)).astype(np.uint8)
+                ).save(d / f"{c}_{i}.JPEG".replace("JPEG", "jpg"))
+    data = load_imagenet(str(tmp_path), client_number=2, image_size=8)
+    assert data.num_clients == 2 and data.num_classes == 4
+    # client 0 owns classes {0,1}, client 1 owns {2,3}
+    assert set(data.y_train[data.train_idx_map[0]]) == {0, 1}
+    assert set(data.y_train[data.train_idx_map[1]]) == {2, 3}
+    assert data.x_train.shape == (12, 8, 8, 3)
+    # client_range decodes only that shard's clients
+    part = load_imagenet(str(tmp_path), client_number=2, image_size=8,
+                         client_range=(1, 2))
+    assert len(part.train_idx_map[0]) == 0
+    assert len(part.train_idx_map[1]) == 6
+
+
+def test_landmarks_user_split(tmp_path):
+    """gld23k-style mapping csv -> natural per-user partition (reference
+    get_mapping_per_user)."""
+    from PIL import Image
+
+    from fedml_tpu.data.largescale import load_landmarks
+
+    rng = np.random.default_rng(0)
+    (tmp_path / "data_user_dict").mkdir()
+    (tmp_path / "images").mkdir()
+    rows = ["user_id,image_id,class"]
+    for u, imgs in ((0, ["a", "b"]), (7, ["c"])):
+        for im in imgs:
+            rows.append(f"{u},{im},{u % 2}")
+            Image.fromarray(
+                rng.integers(0, 255, (8, 8, 3)).astype(np.uint8)
+            ).save(tmp_path / "images" / f"{im}.jpg")
+    (tmp_path / "data_user_dict" / "gld23k_user_dict_train.csv").write_text(
+        "\n".join(rows) + "\n"
+    )
+    (tmp_path / "data_user_dict" / "gld23k_user_dict_test.csv").write_text(
+        "user_id,image_id,class\n0,a,0\n"
+    )
+    data = load_landmarks(str(tmp_path), image_size=8)
+    assert data.num_clients == 2
+    assert len(data.train_idx_map[0]) == 2  # user "0"
+    assert len(data.train_idx_map[1]) == 1  # user "7"
+    assert data.x_test.shape == (1, 8, 8, 3)
+
+
+def test_edge_case_backdoor_suite(tmp_path):
+    """Edge-case pool attacks (southwest/ARDIS analog): pool mixing per
+    attack_case, real-pickle loading, and targeted-task evaluation."""
+    import pickle
+
+    from fedml_tpu.data.natural import (
+        EdgeCasePool,
+        load_southwest_pool,
+        make_edge_case_backdoor,
+        make_procedural_edge_pool,
+    )
+
+    data = make_fake_image_dataset(
+        "cifar10",
+        DataConfig(dataset="fake_cifar10", num_clients=4, seed=0),
+        n_train=400, n_test=80,
+    )
+    pool = make_procedural_edge_pool(data, n_train=50, n_test=20,
+                                     target_label=9)
+    for case in ("edge-case", "almost-edge-case", "normal-case"):
+        poisoned, tx, ty = make_edge_case_backdoor(
+            data, pool, attacker_clients=(1,), attack_case=case,
+            poison_fraction=0.5, seed=0,
+        )
+        idx = np.asarray(data.train_idx_map[1])
+        flipped = (poisoned.y_train[idx] == 9).sum()
+        assert flipped >= len(idx) // 2 - 1
+        assert tx.shape == (20, 32, 32, 3)
+        assert (ty == 9).all()
+        if case == "normal-case":  # inputs unchanged, labels flipped
+            np.testing.assert_array_equal(poisoned.x_train[idx],
+                                          data.x_train[idx])
+        else:  # inputs replaced by pool examples
+            assert not np.allclose(poisoned.x_train[idx], data.x_train[idx])
+        # non-attacker clients untouched
+        idx0 = np.asarray(data.train_idx_map[0])
+        np.testing.assert_array_equal(poisoned.x_train[idx0],
+                                      data.x_train[idx0])
+
+    # real southwest pickle format round-trip
+    rng = np.random.default_rng(0)
+    imgs = rng.integers(0, 255, (30, 32, 32, 3)).astype(np.uint8)
+    for name, arr in (("southwest_images_new_train.pkl", imgs),
+                      ("southwest_images_new_test.pkl", imgs[:10])):
+        with open(tmp_path / name, "wb") as f:
+            pickle.dump(arr, f)
+    sw = load_southwest_pool(str(tmp_path))
+    assert sw.x_train.shape == (30, 32, 32, 3)
+    assert sw.x_train.max() <= 1.0 and sw.target_label == 9
+
+
+def test_nus_wide_two_party_loader(tmp_path):
+    """NUS-WIDE layout round-trip: label txts + normalized feature dats +
+    tags, exactly-one-hot filtering, party column splits."""
+    from fedml_tpu.data.vertical import load_nus_wide_two_party
+
+    rng = np.random.default_rng(0)
+    labels = ["buildings", "grass"]
+    n = 20
+    (tmp_path / "Groundtruth" / "TrainTestLabels").mkdir(parents=True)
+    (tmp_path / "Low_Level_Features").mkdir()
+    (tmp_path / "NUS_WID_Tags").mkdir()
+    for dtype, m in (("Train", n), ("Test", 8)):
+        l0 = rng.integers(0, 2, m)
+        l1 = 1 - l0  # exactly one active for most rows
+        l1[:2] = l0[:2]  # a few invalid rows (0 or 2 active)
+        np.savetxt(tmp_path / "Groundtruth" / "TrainTestLabels"
+                   / f"Labels_buildings_{dtype}.txt", l0, fmt="%d")
+        np.savetxt(tmp_path / "Groundtruth" / "TrainTestLabels"
+                   / f"Labels_grass_{dtype}.txt", l1, fmt="%d")
+        np.savetxt(tmp_path / "Low_Level_Features"
+                   / f"{dtype}_Normalized_CH.dat",
+                   rng.random((m, 3)), fmt="%.4f")
+        np.savetxt(tmp_path / "Low_Level_Features"
+                   / f"{dtype}_Normalized_EDH.dat",
+                   rng.random((m, 2)), fmt="%.4f")
+        np.savetxt(tmp_path / "NUS_WID_Tags" / f"{dtype}_Tags1k.dat",
+                   rng.integers(0, 2, (m, 5)), fmt="%d", delimiter="\t")
+    out = load_nus_wide_two_party(str(tmp_path), selected_labels=labels)
+    x, y = out["train"]
+    assert x.shape[1] == 3 + 2 + 5
+    assert out["splits"] == [(0, 5), (5, 10)]
+    assert set(np.unique(y)) <= {0, 1}
+    # invalid rows (not exactly one concept) were dropped
+    assert x.shape[0] <= n - 1
+
+
+def test_lending_club_two_party_loader(tmp_path):
+    from fedml_tpu.data.vertical import (
+        PARTY_A_FEATS,
+        PARTY_B_FEATS,
+        load_lending_club_two_party,
+    )
+
+    rows = [
+        ",".join(["grade", "emp_length", "home_ownership", "annual_inc",
+                  "verification_status", "loan_amnt", "term",
+                  "initial_list_status", "purpose", "application_type",
+                  "disbursement_method", "int_rate", "installment", "dti",
+                  "delinq_2yrs", "open_acc", "pub_rec", "revol_bal",
+                  "revol_util", "total_acc", "loan_status"])
+    ]
+    import csv as _csv
+    import io
+
+    buf = io.StringIO()
+    w = _csv.writer(buf)
+    w.writerow(rows[0].split(","))
+    statuses = ["Fully Paid", "Charged Off", "Current", "Default"] * 5
+    for i, st in enumerate(statuses):
+        w.writerow(["B", "5 years", "RENT", 50000 + i, "Verified",
+                    10000, " 36 months", "w", "credit_card", "Individual",
+                    "Cash", f"{10 + i * 0.1:.1f}%", 300, 15.0, 0, 8, 0,
+                    12000, "45.3", 20, st])
+    (tmp_path / "loan.csv").write_text(buf.getvalue())
+    out = load_lending_club_two_party(str(tmp_path / "loan.csv"))
+    x_tr, y_tr = out["train"]
+    x_te, y_te = out["test"]
+    assert x_tr.shape[1] == len(PARTY_A_FEATS) + len(PARTY_B_FEATS)
+    assert out["splits"][0] == (0, len(PARTY_A_FEATS))
+    # bad-loan labeling: Charged Off / Default -> 1
+    all_y = np.concatenate([y_tr, y_te])
+    assert all_y.sum() == 10  # half the rows
+
+
+def test_vfl_sim_on_loaded_vertical_data(tmp_path):
+    """The loaders' output feeds VFLSim end-to-end and learns."""
+    from fedml_tpu.algorithms.split import VFLSim
+    from fedml_tpu.models.gkt import VFLDenseModel, VFLLocalModel
+
+    rng = np.random.default_rng(0)
+    n, da, db = 400, 6, 4
+    x = rng.normal(size=(n, da + db)).astype(np.float32)
+    w = rng.normal(size=(da + db,))
+    y = (x @ w > 0).astype(np.int64)
+    data = {"train": (x[:300], y[:300]), "test": (x[300:], y[300:]),
+            "splits": [(0, da), (da, da + db)]}
+    cfg = ExperimentConfig(
+        data=DataConfig(dataset="vfl", batch_size=32),
+        model=ModelConfig(name="lr", num_classes=1, input_shape=(da + db,)),
+        train=TrainConfig(lr=0.1, epochs=1),
+        fed=FedConfig(num_rounds=30, clients_per_round=2, eval_every=30),
+        seed=0,
+    )
+    parties = [
+        (VFLLocalModel(out_dim=8), VFLDenseModel())
+        for _ in data["splits"]
+    ]
+    sim = VFLSim(parties, data["splits"], *data["train"], *data["test"],
+                 cfg)
+    state = sim.init()
+    for _ in range(30):
+        state, _ = sim.run_epoch(state)
+    m = sim.evaluate(state)
+    assert m["test_acc"] > 0.8, m
