@@ -420,3 +420,114 @@ def load_stackoverflow_lr(
         "tag_prediction",
         "fake_stackoverflow_lr",
     )
+
+
+# ---------------------------------------------------------------------------
+# Edge-case (OOD-pool) backdoor attacks
+# ---------------------------------------------------------------------------
+
+
+class EdgeCasePool:
+    """An out-of-distribution example pool used as backdoor ammunition
+    (reference ``edge_case_examples/data_loader.py``: Southwest-airline
+    CIFAR images labeled 'truck', ARDIS digits for EMNIST). ``x_train`` is
+    mixed into attacker clients' data with ``target_label``; ``x_test``
+    measures the targeted task."""
+
+    def __init__(self, x_train: np.ndarray, x_test: np.ndarray,
+                 target_label: int):
+        self.x_train = np.asarray(x_train, np.float32)
+        self.x_test = np.asarray(x_test, np.float32)
+        self.target_label = int(target_label)
+
+
+def load_southwest_pool(
+    data_dir: str, target_label: int = 9
+) -> EdgeCasePool:
+    """The reference's Southwest-airline CIFAR pool
+    (``southwest_images_new_{train,test}.pkl``: pickled uint8 image arrays;
+    airplane -> labeled 'truck' (9), ``data_loader.py:346-371``)."""
+    import pickle
+
+    tr_p = os.path.join(data_dir, "southwest_images_new_train.pkl")
+    te_p = os.path.join(data_dir, "southwest_images_new_test.pkl")
+    _require(tr_p, None)
+    _require(te_p, None)
+    with open(tr_p, "rb") as f:
+        x_tr = np.asarray(pickle.load(f))
+    with open(te_p, "rb") as f:
+        x_te = np.asarray(pickle.load(f))
+    if x_tr.dtype == np.uint8:
+        x_tr = x_tr.astype(np.float32) / 255.0
+        x_te = x_te.astype(np.float32) / 255.0
+    return EdgeCasePool(x_tr, x_te, target_label)
+
+
+def make_procedural_edge_pool(
+    like: FederatedData,
+    n_train: int = 200,
+    n_test: int = 100,
+    target_label: int = 9,
+    seed: int = 0,
+) -> EdgeCasePool:
+    """Offline stand-in for the curated pools: a coherent OOD mode — one
+    fixed out-of-distribution prototype plus small noise, shaped like the
+    task's inputs (the statistical role the Southwest/ARDIS images play:
+    a tight cluster living off the data manifold)."""
+    rng = np.random.default_rng(seed + 0xED6E)
+    shape = like.x_train.shape[1:]
+    proto = rng.normal(0.0, 1.0, shape).astype(np.float32) * 3.0
+    gen = lambda n: proto[None] + rng.normal(
+        0, 0.2, (n,) + shape
+    ).astype(np.float32)
+    return EdgeCasePool(gen(n_train), gen(n_test), target_label)
+
+
+def make_edge_case_backdoor(
+    data: FederatedData,
+    pool: EdgeCasePool,
+    attacker_clients: tuple[int, ...] = (0,),
+    attack_case: str = "edge-case",
+    poison_fraction: float = 0.5,
+    seed: int = 0,
+) -> tuple[FederatedData, np.ndarray, np.ndarray]:
+    """Mix the pool into the attacker clients' local data (reference
+    ``load_poisoned_dataset`` mixing, ``data_loader.py:372-402``):
+
+    - ``edge-case``: replace ``poison_fraction`` of the attacker's samples
+      with pool examples labeled ``target_label`` (pure edge-case poison +
+      remaining clean points).
+    - ``almost-edge-case``: same, but poison examples get small in-
+      distribution noise added (the reference's p-percent variant).
+    - ``normal-case``: attacker data stays clean in-distribution but
+      ``poison_fraction`` of its labels flip to ``target_label``.
+
+    Returns ``(poisoned_data, targeted_x, targeted_y)`` where the targeted
+    test set is the pool's test split labeled ``target_label`` — attack
+    success = accuracy on it (reference poisoned-task eval,
+    ``fedavg_robust/FedAvgRobustAggregator.py:14-64``)."""
+    assert attack_case in ("edge-case", "almost-edge-case", "normal-case")
+    rng = np.random.default_rng(seed)
+    x = data.x_train.copy()
+    y = data.y_train.copy()
+    for c in attacker_clients:
+        idx = np.asarray(data.train_idx_map[c])
+        n_poison = int(len(idx) * poison_fraction)
+        if n_poison == 0:
+            continue
+        chosen = rng.choice(idx, n_poison, replace=False)
+        if attack_case == "normal-case":
+            y[chosen] = pool.target_label
+            continue
+        take = rng.choice(len(pool.x_train), n_poison)
+        px = pool.x_train[take]
+        if attack_case == "almost-edge-case":
+            px = px + rng.normal(0, 0.05, px.shape).astype(np.float32)
+        x[chosen] = px
+        y[chosen] = pool.target_label
+    poisoned = FederatedData(
+        x, y, data.x_test, data.y_test, data.train_idx_map,
+        data.test_idx_map, data.num_classes, data.task,
+    )
+    targeted_y = np.full(len(pool.x_test), pool.target_label, np.int32)
+    return poisoned, pool.x_test, targeted_y
