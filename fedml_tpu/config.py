@@ -174,6 +174,18 @@ class ExperimentConfig:
                     raise KeyError(f"unknown {cls.__name__} field: {k}")
                 if k == "extra" and isinstance(v, Mapping):
                     v = tuple(sorted(v.items()))
+                elif k == "extra" and isinstance(v, Sequence):
+                    # json round-trip turns the tuple-of-pairs (and any
+                    # tuple values) into lists; restore tuples recursively
+                    # so the config stays hashable for jit
+                    detuple = lambda x: (
+                        tuple(detuple(e) for e in x)
+                        if isinstance(x, list)
+                        else x
+                    )
+                    v = tuple(
+                        (p[0], detuple(p[1])) for p in v
+                    )
                 if k == "input_shape" and isinstance(v, Sequence):
                     v = tuple(v)
                 kw[k] = v

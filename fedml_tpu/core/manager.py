@@ -30,7 +30,7 @@ def create_transport(
 ) -> BaseTransport:
     """Backend dispatch by name (reference ``client_manager.py:28-50``:
     backend in {MPI, MQTT, MQTT_S3, GRPC, TRPC}; here {LOOPBACK, TCP,
-    GRPC, PUBSUB, PUBSUB_BLOB} — PUBSUB is the MQTT-shaped topic bus,
+    GRPC, TRPC, PUBSUB, PUBSUB_BLOB} — PUBSUB is the MQTT-shaped topic bus,
     PUBSUB_BLOB adds the S3-shaped control/data-plane split)."""
     backend = backend.upper()
     if backend == "LOOPBACK":
@@ -46,6 +46,11 @@ def create_transport(
 
         assert ip_config is not None
         return GrpcTransport(rank, ip_config)
+    if backend in ("TRPC", "TENSOR_RPC"):
+        from fedml_tpu.core.transport.tensor_rpc import TensorRpcTransport
+
+        assert ip_config is not None
+        return TensorRpcTransport(rank, ip_config)
     if backend in ("PUBSUB", "MQTT"):
         from fedml_tpu.core.transport.pubsub import PubSubTransport
 

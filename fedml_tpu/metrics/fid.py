@@ -156,7 +156,14 @@ def make_fid_scorer(
     import os
 
     path = inception_path or os.environ.get("FEDML_TPU_INCEPTION")
-    if path and os.path.exists(path):
+    if path:
+        if not os.path.exists(path):
+            # an explicitly requested extractor that is missing must NOT
+            # silently degrade to the offline embed — the numbers would
+            # look comparable to published FID but not be
+            raise FileNotFoundError(
+                f"Inception TorchScript file not found: {path}"
+            )
         return FIDScorer(embed_fn=TorchScriptEmbed(path),
                          batch_size=batch_size)
     return FIDScorer(batch_size=batch_size)

@@ -77,7 +77,10 @@ def _one_client_eval(model, num_classes: int, batch_size: int):
     return one_client
 
 
-_EVAL_CACHE: dict = {}
+# bounded LRU: keeps recent evaluators' compiled executables alive without
+# pinning every model a long sweep ever evaluated
+_EVAL_CACHE: "dict" = {}
+_EVAL_CACHE_MAX = 8
 
 
 def build_per_client_eval(
@@ -93,14 +96,16 @@ def build_per_client_eval(
     logging reuses one compiled evaluator instead of re-jitting a fresh
     closure every call."""
     key = (id(model), num_classes, batch_size, stacked)
-    fn = _EVAL_CACHE.get(key)
+    fn = _EVAL_CACHE.pop(key, None)
     if fn is None:
         one = _one_client_eval(model, num_classes, batch_size)
         in_axes = (
             (0, None, None, 0, 0) if stacked else (None, None, None, 0, 0)
         )
         fn = jax.jit(jax.vmap(one, in_axes=in_axes))
-        _EVAL_CACHE[key] = fn
+    _EVAL_CACHE[key] = fn  # re-insert = most recently used
+    while len(_EVAL_CACHE) > _EVAL_CACHE_MAX:
+        _EVAL_CACHE.pop(next(iter(_EVAL_CACHE)))
     return fn
 
 

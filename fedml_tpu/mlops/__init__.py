@@ -1,0 +1,1 @@
+from fedml_tpu.mlops.packaging import build_mlops_packages  # noqa: F401

@@ -1,0 +1,6 @@
+from fedml_tpu.mobile.export import (  # noqa: F401
+    params_from_weight_lists,
+    params_to_weight_lists,
+    save_weight_lists,
+    load_weight_lists,
+)

@@ -1,0 +1,71 @@
+"""Mobile-client weight exchange: JSON weight lists.
+
+Reference: mobile clients exchange model weights as nested JSON lists
+(``is_mobile`` flag; ``fedml_api/distributed/fedavg/utils.py:7-16``
+``transform_tensor_to_list`` / ``transform_list_to_tensor``), and the MNN
+converters (``fedml_api/model/mobile/mnn_torch.py``) bridge torch
+state_dicts to the MNN mobile engine by walking aligned weight lists.
+
+TPU analog: a flax variables pytree <-> nested JSON-able lists, with the
+tree structure (paths + shapes + dtypes) carried alongside so the inverse
+is exact. This is the wire format an on-device (non-JAX) client can
+produce/consume, and the unit the MNN-style converter walks.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+import jax
+import numpy as np
+
+
+def params_to_weight_lists(variables: Any) -> dict:
+    """Pytree -> {"paths": [...], "shapes": [...], "dtypes": [...],
+    "weights": [nested lists...]} (reference ``transform_tensor_to_list``,
+    generalized to arbitrary pytrees with an exact inverse)."""
+    leaves_with_paths = jax.tree_util.tree_flatten_with_path(variables)[0]
+    paths, weights, shapes, dtypes = [], [], [], []
+    for path, leaf in leaves_with_paths:
+        arr = np.asarray(leaf)
+        paths.append(jax.tree_util.keystr(path))
+        shapes.append(list(arr.shape))
+        dtypes.append(str(arr.dtype))
+        weights.append(arr.tolist())
+    return {
+        "paths": paths,
+        "shapes": shapes,
+        "dtypes": dtypes,
+        "weights": weights,
+    }
+
+
+def params_from_weight_lists(template: Any, payload: dict) -> Any:
+    """Inverse of :func:`params_to_weight_lists` onto a structure-matching
+    template pytree (reference ``transform_list_to_tensor``)."""
+    leaves, treedef = jax.tree_util.tree_flatten(template)
+    assert len(leaves) == len(payload["weights"]), (
+        len(leaves), len(payload["weights"])
+    )
+    new_leaves = [
+        np.asarray(w, dtype=np.dtype(dt)).reshape(shape)
+        for w, shape, dt in zip(
+            payload["weights"], payload["shapes"], payload["dtypes"]
+        )
+    ]
+    for a, b in zip(leaves, new_leaves):
+        assert tuple(np.asarray(a).shape) == tuple(b.shape), (
+            np.asarray(a).shape, b.shape
+        )
+    return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+
+def save_weight_lists(variables: Any, path: str) -> None:
+    with open(path, "w") as f:
+        json.dump(params_to_weight_lists(variables), f)
+
+
+def load_weight_lists(template: Any, path: str) -> Any:
+    with open(path) as f:
+        return params_from_weight_lists(template, json.load(f))

@@ -225,3 +225,72 @@ def test_per_client_observability_sink():
                arrays.test_mask)
     np.testing.assert_allclose(np.asarray(out["acc"]),
                                np.asarray(test["acc"]), rtol=1e-6)
+
+
+def test_mlops_packaging_bundles(tmp_path):
+    """build-mlops-package equivalent: client/server zips with
+    package/main.py + conf (reference build.sh dist layout)."""
+    import zipfile
+
+    from fedml_tpu.config import ExperimentConfig
+    from fedml_tpu.mlops import build_mlops_packages
+
+    out = build_mlops_packages(
+        ExperimentConfig(), str(tmp_path), world_size=3,
+        backend="GRPC", ip_config={0: ("127.0.0.1", 9000)},
+    )
+    for side in ("client", "server"):
+        assert os.path.exists(out[side])
+        names = zipfile.ZipFile(out[side]).namelist()
+        assert f"fedml-{side}/package/main.py" in names
+        assert f"fedml-{side}/package/conf/fedml.json" in names
+        src = zipfile.ZipFile(out[side]).read(
+            f"fedml-{side}/package/main.py"
+        ).decode()
+        compile(src, "main.py", "exec")  # entry script is valid python
+        conf = json.loads(zipfile.ZipFile(out[side]).read(
+            f"fedml-{side}/package/conf/fedml.json"))
+        assert conf["world_size"] == 3
+
+
+def test_mobile_weight_lists_roundtrip(tmp_path):
+    """is_mobile JSON weight lists (reference distributed/fedavg/utils.py
+    transform_tensor_to_list / transform_list_to_tensor)."""
+    import jax
+
+    from fedml_tpu.config import ModelConfig
+    from fedml_tpu.mobile import (
+        load_weight_lists,
+        params_to_weight_lists,
+        save_weight_lists,
+    )
+    from fedml_tpu.models import create_model
+
+    model = create_model(
+        ModelConfig(name="lr", num_classes=10, input_shape=(8,))
+    )
+    variables = model.init(jax.random.key(0))
+    payload = params_to_weight_lists(variables)
+    assert len(payload["weights"]) == len(jax.tree.leaves(variables))
+    p = tmp_path / "w.json"
+    save_weight_lists(variables, str(p))
+    restored = load_weight_lists(variables, str(p))
+    for a, b in zip(jax.tree.leaves(variables), jax.tree.leaves(restored)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_tensor_rpc_transport_and_benchmark():
+    from fedml_tpu.core.manager import create_transport
+    from fedml_tpu.core.transport.tensor_rpc import benchmark_transport
+
+    ip = {0: ("127.0.0.1", 29741), 1: ("127.0.0.1", 29742)}
+    a = create_transport("trpc", 0, ip_config=ip)
+    b = create_transport("trpc", 1, ip_config=ip)
+    a.start()
+    b.start()
+    res = benchmark_transport(a, b, sizes=(1000, 100000), repeats=2)
+    assert len(res) == 2
+    assert res[0]["size_bytes"] == 4000
+    assert all(r["mean_ms"] > 0 for r in res)
+    a.stop()
+    b.stop()
