@@ -239,10 +239,13 @@ class OnlineDecentralizedSim:
             W = W / jnp.maximum(W.sum(axis=1, keepdims=True), 1e-12)
         self.W = W
 
-    def run(self):
+    def run(self, metrics_sink=None, log_every: int = 10):
         """Run the full stream; returns a dict with the per-iteration loss
         matrix [T, N], the running average regret curve [T]
-        (reference ``cal_regret``), and the final stacked params."""
+        (reference ``cal_regret``), and the final stacked params. When a
+        ``metrics_sink`` is given, the regret curve is logged every
+        ``log_every`` iterations plus one final record (exactly one record
+        per logged round)."""
         n, t = self.n, self.t
         d = self.x.shape[-1]
         lr, wd = self.lr, self.wd
@@ -298,9 +301,21 @@ class OnlineDecentralizedSim:
         # regret(t) = sum_{s<=t} sum_i loss_{s,i} / (N * (t+1))
         per_iter = losses.sum(axis=1)  # [T]
         regret = jnp.cumsum(per_iter) / (n * jnp.arange(1, t + 1))
-        return {
+        out = {
             "losses": losses,
             "regret": regret,
             "params": (z_w, z_b),
             "final_regret": float(regret[-1]),
         }
+        if metrics_sink is not None:
+            r_host = np.asarray(regret)
+            step = max(1, int(log_every))
+            for it in range(step - 1, t - 1, step):
+                metrics_sink.log(
+                    {"round": it, "regret": float(r_host[it])}
+                )
+            metrics_sink.log(
+                {"round": t - 1, "regret": float(r_host[-1]),
+                 "final_regret": float(r_host[-1])}
+            )
+        return out
