@@ -239,7 +239,10 @@ class OnlineDecentralizedSim:
             W = W / jnp.maximum(W.sum(axis=1, keepdims=True), 1e-12)
         self.W = W
 
-    def run(self, metrics_sink=None, log_every: int = 10):
+    # sink-logging cadence; the harness sets this from cfg.fed.eval_every
+    log_every: int = 10
+
+    def run(self, metrics_sink=None, log_every: int | None = None):
         """Run the full stream; returns a dict with the per-iteration loss
         matrix [T, N], the running average regret curve [T]
         (reference ``cal_regret``), and the final stacked params. When a
@@ -309,7 +312,9 @@ class OnlineDecentralizedSim:
         }
         if metrics_sink is not None:
             r_host = np.asarray(regret)
-            step = max(1, int(log_every))
+            step = max(
+                1, int(self.log_every if log_every is None else log_every)
+            )
             for it in range(step - 1, t - 1, step):
                 metrics_sink.log(
                     {"round": it, "regret": float(r_host[it])}

@@ -39,6 +39,20 @@ class MLOpsLogger:
         self.run_id: str | None = None
         self.edge_id: int | None = None
 
+    @classmethod
+    def over_bus(cls, bus, jsonl_path: str | None = None) -> "MLOpsLogger":
+        """Transport-backed status channel: publish every record onto a
+        pub-sub bus under its MQTT-style topic (the reference's production
+        wiring — ``MLOpsLogger`` over ``MqttS3StatusManager``,
+        ``mlops_logger.py:24-29``). Any subscriber (e.g. a platform
+        bridge) receives JSON payloads per topic."""
+        return cls(
+            sink=lambda topic, payload: bus.publish(
+                topic, json.dumps(payload).encode("utf-8")
+            ),
+            jsonl_path=jsonl_path,
+        )
+
     def set_context(self, run_id: str, edge_id: int = 0):
         self.run_id = run_id
         self.edge_id = edge_id

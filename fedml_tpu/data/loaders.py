@@ -517,6 +517,13 @@ def load_dataset(cfg: DataConfig) -> FederatedData:
         from fedml_tpu.data.natural import load_leaf_json
 
         base = name[len("leaf_"):]
+        if base in ("shakespeare", "fed_shakespeare"):
+            from fedml_tpu.data.natural import SHAKESPEARE_VOCAB_SIZE
+
+            return load_leaf_json(
+                cfg.data_dir, SHAKESPEARE_VOCAB_SIZE, task="nwp",
+                offline_hint="fake_shakespeare", text=True,
+            )
         shapes = {"femnist": ((28, 28, 1), 62), "celeba": ((84, 84, 3), 2),
                   "synthetic": (None, 10)}
         if base not in shapes:

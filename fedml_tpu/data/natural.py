@@ -100,11 +100,14 @@ def load_leaf_json(
     task: str = "classification",
     x_shape: tuple | None = None,
     offline_hint: str | None = None,
+    text: bool = False,
 ) -> FederatedData:
     """LEAF json splits (reference femnist/shakespeare download scripts):
     ``train/*.json`` + ``test/*.json`` with users/user_data.
     ``offline_hint`` names a fake dataset substitute for the error message
-    (only femnist has an offline stand-in)."""
+    (only femnist has an offline stand-in). ``text=True`` reads the LEAF
+    *text* format (shakespeare: x = 80-char context strings, y = next
+    char) and tokenizes with the shared char vocabulary."""
 
     def read_split(split):
         out = {}
@@ -117,6 +120,9 @@ def load_leaf_json(
                 blob = json.load(f)
             for uid in blob["users"]:
                 ud = blob["user_data"][uid]
+                if text:
+                    out[uid] = _leaf_text_to_arrays(ud["x"], ud["y"])
+                    continue
                 x = np.asarray(ud["x"], np.float32)
                 if x_shape is not None:
                     x = x.reshape((-1,) + tuple(x_shape))
@@ -127,13 +133,39 @@ def load_leaf_json(
     test = read_split("test")
     uids = sorted(train.keys())
     x_tr, y_tr, tr_map = _natural_maps([train[u] for u in uids])
+    # users absent from the test split (LEAF --by-user) get empty slices
+    # whose shapes/dtypes MATCH the train arrays (text y is [n, L] int32,
+    # not a 1-D label vector)
+    empty = (
+        np.zeros((0,) + x_tr.shape[1:], x_tr.dtype),
+        np.zeros((0,) + y_tr.shape[1:], y_tr.dtype),
+    )
     x_te, y_te, te_map = _natural_maps(
-        [test.get(u, (np.zeros((0,) + x_tr.shape[1:], np.float32),
-                      np.zeros((0,), np.int32))) for u in uids]
+        [test.get(u, empty) for u in uids]
     )
     return FederatedData(
         x_tr, y_tr, x_te, y_te, tr_map, te_map, num_classes, task
     )
+
+
+def _leaf_text_to_arrays(xs: list, ys: list):
+    """LEAF shakespeare text rows -> (tokens [n, L], next-char [n, L])
+    shifted LM targets: the context window is tokenized with the shared
+    char vocabulary (reference ``models/shakespeare`` LEAF pipeline:
+    80-char context x, single next char y — we emit full shifted targets,
+    whose last column IS the LEAF y)."""
+    char_id, oov = SHAKESPEARE_CHAR_ID, SHAKESPEARE_OOV
+
+    def tok(s):
+        return [char_id.get(c, oov) for c in s]
+
+    x = np.asarray([tok(s) for s in xs], np.int32)
+    y_last = np.asarray(
+        [char_id.get(c[0] if c else " ", oov) for c in ys], np.int32
+    )
+    # shifted targets: y[:, :-1] = x[:, 1:], y[:, -1] = LEAF's next char
+    y = np.concatenate([x[:, 1:], y_last[:, None]], axis=1)
+    return x, y
 
 
 def _require(path: str, fake_name: str | None):
@@ -219,6 +251,12 @@ SHAKESPEARE_CHARS = list(
 )
 SHAKESPEARE_VOCAB_SIZE = len(SHAKESPEARE_CHARS) + 4  # pad + bos + eos + oov
 SHAKESPEARE_SEQ_LEN = 80
+# token id layout shared by every shakespeare tokenizer in this module:
+# 0 = pad, 1..86 = chars, 87 = bos, 88 = eos, 89 = oov
+SHAKESPEARE_CHAR_ID = {c: i + 1 for i, c in enumerate(SHAKESPEARE_CHARS)}
+SHAKESPEARE_BOS = len(SHAKESPEARE_CHARS) + 1
+SHAKESPEARE_EOS = len(SHAKESPEARE_CHARS) + 2
+SHAKESPEARE_OOV = len(SHAKESPEARE_CHARS) + 3
 
 
 def shakespeare_to_sequences(
@@ -229,9 +267,8 @@ def shakespeare_to_sequences(
     ``[bos] + chars + [eos]``, zero-padded to a multiple of ``seq_len+1``,
     then chopped into ``[seq_len+1]`` windows. Returns ``[n, seq_len+1]``
     int32 (callers split into x = [:, :-1] / y = [:, 1:])."""
-    char_id = {c: i + 1 for i, c in enumerate(SHAKESPEARE_CHARS)}
-    n_words = len(SHAKESPEARE_CHARS) + 3  # pad + chars + bos + eos
-    bos, eos, oov = n_words - 2, n_words - 1, n_words
+    char_id = SHAKESPEARE_CHAR_ID
+    bos, eos, oov = SHAKESPEARE_BOS, SHAKESPEARE_EOS, SHAKESPEARE_OOV
     seqs = []
     for sn in snippets:
         tokens = [bos] + [char_id.get(c, oov) for c in sn] + [eos]

@@ -196,11 +196,7 @@ def _build_dol(method):
             xs, ys, method=method, lr=cfg.train.lr,
             weight_decay=cfg.train.weight_decay, seed=cfg.seed,
         )
-        # honor the harness's eval cadence in the sink log
-        orig_run = sim.run
-        sim.run = lambda metrics_sink=None: orig_run(
-            metrics_sink=metrics_sink, log_every=cfg.fed.eval_every
-        )
+        sim.log_every = cfg.fed.eval_every  # harness eval cadence
         return sim
 
     return build

@@ -68,6 +68,14 @@ def create_model(cfg: ModelConfig) -> FedModel:
         return FedModel(
             ResNetCIFAR(depth, nc, norm="gn"), cfg.input_shape
         )
+    if name.startswith("resnet") and name.endswith("_s2d"):
+        # TPU-optimized space-to-depth layout (see ResNetCIFAR docstring)
+        depth = int(name[len("resnet"):-len("_s2d")])
+        return FedModel(
+            ResNetCIFAR(depth, nc, norm="bn", space_to_depth=True),
+            cfg.input_shape,
+            has_batch_stats=True,
+        )
     if name.startswith("resnet"):
         depth = int(name[len("resnet"):])
         return FedModel(

@@ -13,7 +13,7 @@ from fedml_tpu.config import (
     ModelConfig,
     TrainConfig,
 )
-from fedml_tpu.data.loaders import make_fake_image_dataset
+from fedml_tpu.data.loaders import load_dataset, make_fake_image_dataset
 from fedml_tpu.data.natural import (
     backdoor_success_rate,
     load_federated_emnist,
@@ -522,3 +522,38 @@ def test_vfl_sim_on_loaded_vertical_data(tmp_path):
         state, _ = sim.run_epoch(state)
     m = sim.evaluate(state)
     assert m["test_acc"] > 0.8, m
+
+
+def test_leaf_text_shakespeare_json(tmp_path):
+    """LEAF text format (shakespeare): 80-char contexts + next-char labels
+    tokenize with the shared char vocabulary into shifted LM targets."""
+    from fedml_tpu.data.natural import SHAKESPEARE_CHARS
+
+    ctx = "to be or not to be that is the question "
+    blob = {
+        "users": ["u0", "u1"],
+        "user_data": {
+            "u0": {"x": [ctx, ctx[1:] + "x"], "y": ["t", "h"]},
+            "u1": {"x": [ctx], "y": ["q"]},
+        },
+    }
+    for split in ("train", "test"):
+        d = tmp_path / split
+        d.mkdir()
+        (d / "data.json").write_text(json.dumps(blob))
+    # test split is missing u1 (LEAF --by-user): its slice must be an
+    # empty [0, L] int32, not a 1-D float placeholder
+    test_blob = {"users": ["u0"],
+                 "user_data": {"u0": {"x": [ctx], "y": ["t"]}}}
+    (tmp_path / "test" / "data.json").write_text(json.dumps(test_blob))
+    data = load_dataset(
+        DataConfig(dataset="leaf_shakespeare", data_dir=str(tmp_path))
+    )
+    assert data.task == "nwp" and data.num_clients == 2
+    assert data.x_test.dtype == np.int32
+    assert len(data.test_idx_map[1]) == 0  # u1 absent from test
+    assert data.x_train.shape == (3, len(ctx))
+    char_id = {c: i + 1 for i, c in enumerate(SHAKESPEARE_CHARS)}
+    # shifted: y[:, :-1] == x[:, 1:], last y col is the LEAF next char
+    np.testing.assert_array_equal(data.y_train[0, :-1], data.x_train[0, 1:])
+    assert data.y_train[0, -1] == char_id["t"]

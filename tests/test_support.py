@@ -294,3 +294,28 @@ def test_tensor_rpc_transport_and_benchmark():
     assert all(r["mean_ms"] > 0 for r in res)
     a.stop()
     b.stop()
+
+
+def test_mlops_logger_over_pubsub_bus():
+    """Transport-backed status channel (reference MLOpsLogger -> MQTT
+    status topics): records arrive at bus subscribers as JSON."""
+    from fedml_tpu.core.mlops import (
+        TOPIC_CLIENT_STATUS,
+        TOPIC_TRAINING_PROGRESS,
+        MLOpsLogger,
+    )
+    from fedml_tpu.core.transport.pubsub import TopicBus
+
+    bus = TopicBus()
+    got = []
+    bus.subscribe(TOPIC_CLIENT_STATUS, lambda t, p: got.append((t, p)))
+    bus.subscribe(TOPIC_TRAINING_PROGRESS, lambda t, p: got.append((t, p)))
+    logger = MLOpsLogger.over_bus(bus)
+    logger.set_context("run42", edge_id=3)
+    logger.report_client_training_status(3, "TRAINING")
+    logger.report_training_progress(7, {"acc": 0.9})
+    assert len(got) == 2
+    rec = json.loads(got[0][1])
+    assert rec["status"] == "TRAINING" and rec["run_id"] == "run42"
+    rec2 = json.loads(got[1][1])
+    assert rec2["round"] == 7 and rec2["acc"] == 0.9
