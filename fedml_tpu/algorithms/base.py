@@ -311,7 +311,12 @@ def build_local_update(
                     )
                     # keep batch_stats consistent across the data axis
                     # (sync-BN-lite; reference uses SynchronizedBatchNorm
-                    # for fedseg, batchnorm_utils.py:240)
+                    # for fedseg, batchnorm_utils.py:240). For EXACT
+                    # synchronized moments use a model built with
+                    # ModelConfig(extra=(("norm", "syncbn:<data_axis>"),))
+                    # — models.vision.SyncBatchNorm psums the batch
+                    # statistics inside the forward; this pmean is then a
+                    # no-op on its already-identical stats.
                     new_vars = {
                         k: (
                             jax.lax.pmean(v, data_axis)

@@ -78,8 +78,10 @@ def create_model(cfg: ModelConfig) -> FedModel:
         )
     if name.startswith("resnet"):
         depth = int(name[len("resnet"):])
+        # extra=(("norm", "syncbn:data"),) opts into exact cross-shard BN
+        # on the named mesh axis (models.vision.SyncBatchNorm)
         return FedModel(
-            ResNetCIFAR(depth, nc, norm="bn"),
+            ResNetCIFAR(depth, nc, norm=extra.get("norm", "bn")),
             cfg.input_shape,
             has_batch_stats=True,
         )

@@ -13,7 +13,9 @@ from __future__ import annotations
 from typing import Any, Sequence
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 class LogisticRegression(nn.Module):
@@ -89,7 +91,29 @@ def _norm(kind: str, train: bool):
         return nn.BatchNorm(use_running_average=not train, momentum=0.9)
     if kind == "gn":
         return nn.GroupNorm(num_groups=2)
+    if kind.startswith("syncbn"):
+        # "syncbn:<axis>" = exact cross-shard BN over that mesh axis
+        # (reference SynchronizedBatchNorm; see SyncBatchNorm below).
+        # The axis is REQUIRED — an axis-less syncbn would silently be
+        # per-shard BN, the exact bug the kind exists to prevent.
+        if not kind.startswith("syncbn:") or not kind.split(":", 1)[1]:
+            raise ValueError(
+                f"{kind!r}: use 'syncbn:<mesh_axis>' (e.g. 'syncbn:data')"
+            )
+        return _SyncBNShim(axis_name=kind.split(":", 1)[1], train=train)
     raise ValueError(kind)
+
+
+class _SyncBNShim(nn.Module):
+    """Adapter so SyncBatchNorm drops into the _norm(...)(y) call shape
+    (the other norms take train at construction or ignore it)."""
+
+    axis_name: str | None
+    train: bool
+
+    @nn.compact
+    def __call__(self, x):
+        return SyncBatchNorm(axis_name=self.axis_name)(x, train=self.train)
 
 
 class BasicBlock(nn.Module):
@@ -237,3 +261,61 @@ class VGG(nn.Module):
         x = x.reshape((x.shape[0], -1))
         x = nn.relu(nn.Dense(512)(x))
         return nn.Dense(self.num_classes)(x)
+
+
+class SyncBatchNorm(nn.Module):
+    """EXACT cross-shard BatchNorm (reference ``SynchronizedBatchNorm2d``,
+    ``fedml_api/model/cv/batchnorm_utils.py:292`` — used by fedseg for
+    DDP-correct batch statistics). Batch mean/variance are computed from
+    psum-reduced (count, sum, sum-of-squares) over ``axis_name``, so the
+    normalization equals single-device BN on the concatenated global batch
+    — not the per-shard approximation. Use inside ``shard_map`` over a
+    data axis; with ``axis_name=None`` it degrades to plain BN.
+
+    Parity note: train-time normalization is exact vs full-batch BN. The
+    running-var EMA stores the BIASED batch variance — the flax
+    ``nn.BatchNorm`` convention used throughout this zoo — whereas torch's
+    SynchronizedBatchNorm stores the unbiased (n/(n-1)) estimator; eval
+    outputs differ from torch by that factor's sqrt per update."""
+
+    axis_name: str | None = None
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        ch = x.shape[-1]
+        scale = self.param("scale", nn.initializers.ones, (ch,))
+        bias = self.param("bias", nn.initializers.zeros, (ch,))
+        ra_mean = self.variable(
+            "batch_stats", "mean", lambda: jnp.zeros((ch,))
+        )
+        ra_var = self.variable(
+            "batch_stats", "var", lambda: jnp.ones((ch,))
+        )
+        if train:
+            red = tuple(range(x.ndim - 1))
+            n = jnp.asarray(
+                np.prod([x.shape[i] for i in red]), jnp.float32
+            )
+            s = jnp.sum(x, axis=red)
+            ss = jnp.sum(jnp.square(x), axis=red)
+            if self.axis_name is not None:
+                n = jax.lax.psum(n, self.axis_name)
+                s = jax.lax.psum(s, self.axis_name)
+                ss = jax.lax.psum(ss, self.axis_name)
+            mean = s / n
+            var = jnp.maximum(ss / n - jnp.square(mean), 0.0)
+            if not self.is_initializing():
+                ra_mean.value = (
+                    self.momentum * ra_mean.value
+                    + (1.0 - self.momentum) * mean
+                )
+                ra_var.value = (
+                    self.momentum * ra_var.value
+                    + (1.0 - self.momentum) * var
+                )
+        else:
+            mean, var = ra_mean.value, ra_var.value
+        y = (x - mean) / jnp.sqrt(var + self.epsilon)
+        return y * scale + bias

@@ -1,7 +1,9 @@
 """Model zoo smoke tests: init + forward shapes for every factory entry."""
 
+import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import numpy as np
 import pytest
 
 pytestmark = pytest.mark.slow
@@ -77,3 +79,61 @@ def test_resnet_has_batch_stats():
     )
     variables = model.init(jax.random.key(0))
     assert "batch_stats" in variables
+
+
+def test_sync_batchnorm_exact_across_shards():
+    """SyncBatchNorm under a 4-way data shard_map == plain BN on the full
+    concatenated batch — forward outputs AND running-stat updates
+    (reference SynchronizedBatchNorm parity; our previous sync-BN-lite
+    only pmean'd the stats after the fact)."""
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from fedml_tpu.models.vision import SyncBatchNorm
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("data",))
+    x = jax.random.normal(jax.random.key(0), (16, 8, 8, 6)) * 2.0 + 1.0
+
+    ref_bn = nn.BatchNorm(use_running_average=False, momentum=0.9,
+                          use_bias=True, use_scale=True)
+    sync = SyncBatchNorm(axis_name="data", momentum=0.9)
+    v = sync.init({"params": jax.random.key(1)}, x[:4], train=False)
+
+    # reference: flax BN on the FULL batch (same init: scale 1, bias 0)
+    rv = ref_bn.init({"params": jax.random.key(1)}, x)
+    ref_out, ref_mut = ref_bn.apply(rv, x, mutable=["batch_stats"])
+
+    def shard_fn(v, xs):
+        out, mut = sync.apply(v, xs, train=True, mutable=["batch_stats"])
+        return out, mut
+
+    out, mut = shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(P(), P("data")),
+        out_specs=(P("data"), P()),
+        check_vma=False,
+    )(v, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out),
+                               atol=1e-5, rtol=1e-5)
+    # running stats: flax BN EMA uses momentum on (mean, var) the same way
+    np.testing.assert_allclose(
+        np.asarray(mut["batch_stats"]["mean"]),
+        np.asarray(ref_mut["batch_stats"]["mean"]), atol=1e-5, rtol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(mut["batch_stats"]["var"]),
+        np.asarray(ref_mut["batch_stats"]["var"]), atol=1e-4, rtol=1e-3,
+    )
+
+    # the "syncbn:<axis>" norm kind wires it through the ResNet zoo
+    from fedml_tpu.models.vision import ResNetCIFAR
+
+    m = ResNetCIFAR(depth=8, num_classes=4, norm="syncbn:data")
+    def init_fn(xs):
+        return m.init({"params": jax.random.key(2)}, xs, train=False)
+    v2 = shard_map(
+        init_fn, mesh=mesh, in_specs=(P("data"),), out_specs=P(),
+        check_vma=False,
+    )(x[:, :, :, :3])
+    assert "batch_stats" in v2
