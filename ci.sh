@@ -32,6 +32,33 @@ smoke fake_cifar10 resnet20 10 "32 32 3"
 smoke fake_shakespeare rnn  90 "80"
 smoke fake_stackoverflow_lr tag_lr 50 "1000"
 
+# robust-aggregation smoke (reference CI-script-fedavg-robust.sh)
+echo "  -- fedavg_robust fake_mnist/lr"
+python -m fedml_tpu.experiments.run \
+  --algorithm fedavg_robust --dataset fake_mnist --model lr \
+  --client_num_in_total 4 --client_num_per_round 4 --comm_round 2 \
+  --epochs 1 --batch_size 16 --num_classes 10 --input_shape 28 28 1 \
+  --robust_method median --robust_norm_clip 1.0 \
+  --robust_noise_stddev 0.001 \
+  --out_dir "$OUT/smoke" --run_name smoke_robust > "$OUT/smoke_robust.json"
+echo "  -- decentralized dol_dsgd (regret)"
+python -m fedml_tpu.experiments.run \
+  --algorithm dol_dsgd --dataset fake_susy --client_num_in_total 4 \
+  --comm_round 50 --lr 0.3 --out_dir "$OUT/smoke" \
+  --run_name smoke_dol > "$OUT/smoke_dol.json"
+
+if [ "${1:-}" = "full" ]; then
+  # slow-compiling batteries, mirroring the reference's separate
+  # CI-script-fednas.sh (several minutes of XLA compile on CPU)
+  echo "  -- fednas search (full mode)"
+  python -m fedml_tpu.experiments.run \
+    --algorithm fednas --dataset fake_mnist --model lr \
+    --client_num_in_total 2 --client_num_per_round 2 --comm_round 1 \
+    --epochs 1 --batch_size 16 --num_classes 10 --input_shape 28 28 1 \
+    --out_dir "$OUT/smoke" --run_name smoke_fednas \
+    > "$OUT/smoke_fednas.json"
+fi
+
 echo "== 3/3 convergence-equivalence oracle =="
 # full-batch (batch_size=-1) + epochs=1: FedAvg over all clients ==
 # centralized == single-group hierarchical, to 3 decimals (a mathematical

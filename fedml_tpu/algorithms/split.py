@@ -476,23 +476,22 @@ class SplitNNSim:
             )
             return (server_vars, server_os, loss_sum, correct_sum, n_sum), c_vars
 
-        # sequential ring: python loop over clients (n is static & small in
-        # the split setting — the reference caps it at the silo count)
-        server_vars, server_os = state.server_vars, state.server_opt_state
-        loss_sum = jnp.asarray(0.0)
-        correct_sum = jnp.asarray(0.0)
-        n_sum = jnp.asarray(0.0)
-        new_client_vars = []
-        for c in range(n):
-            (server_vars, server_os, loss_sum, correct_sum, n_sum), c_vars = (
-                one_client(
-                    (server_vars, server_os, loss_sum, correct_sum, n_sum),
-                    c,
-                )
+        # sequential ring as ONE lax.scan over clients: compile time and
+        # program size are O(1) in the client count (the previous python
+        # loop unrolled O(N) copies of the epoch body); scan stacks each
+        # client's updated variables as its per-step output
+        (server_vars, server_os, loss_sum, correct_sum, n_sum), new_stack = (
+            jax.lax.scan(
+                one_client,
+                (
+                    state.server_vars,
+                    state.server_opt_state,
+                    jnp.asarray(0.0),
+                    jnp.asarray(0.0),
+                    jnp.asarray(0.0),
+                ),
+                jnp.arange(n),
             )
-            new_client_vars.append(c_vars)
-        new_stack = jax.tree.map(
-            lambda *leaves: jnp.stack(leaves), *new_client_vars
         )
         metrics = {
             "train_loss": loss_sum / (n * steps),
@@ -526,15 +525,25 @@ class SplitNNSim:
     def run_round(self, state: SplitNNState):
         return self._round_fn(state, self.arrays)
 
-    def evaluate(self, state: SplitNNState, client_idx: int = 0) -> dict:
+    def evaluate(
+        self, state: SplitNNState, client_idx: int = 0, batch: int = 256
+    ) -> dict:
+        """Composed lower+upper stack accuracy, batched so the test set
+        never materializes one giant activation tensor."""
         c_vars = jax.tree.map(
             lambda s: s[client_idx], state.client_stack
         )
         x, y = self.arrays.test_x, self.arrays.test_y
-        acts = self.client_model.apply(c_vars, x, train=False)
-        out = self.server_model.apply(state.server_vars, acts, train=False)
-        acc = float(jnp.mean(jnp.argmax(out, -1) == y))
-        return {"test_acc": acc}
+        correct = total = 0
+        for s in range(0, x.shape[0], batch):
+            xb, yb = x[s:s + batch], y[s:s + batch]
+            acts = self.client_model.apply(c_vars, xb, train=False)
+            out = self.server_model.apply(
+                state.server_vars, acts, train=False
+            )
+            correct += int(jnp.sum(jnp.argmax(out, -1) == yb))
+            total += xb.shape[0]
+        return {"test_acc": correct / max(total, 1)}
 
 
 # ---------------------------------------------------------------------------

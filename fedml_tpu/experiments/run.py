@@ -41,6 +41,10 @@ def parse_args(argv=None) -> tuple[ExperimentConfig, int]:
     p.add_argument("--partition_method", type=str, default=None)
     p.add_argument("--partition_alpha", type=float, default=None)
     p.add_argument("--frequency_of_the_test", type=int, default=None)
+    p.add_argument("--robust_method", type=str, default=None,
+                   choices=["mean", "median", "trimmed_mean"])
+    p.add_argument("--robust_norm_clip", type=float, default=None)
+    p.add_argument("--robust_noise_stddev", type=float, default=None)
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--repetitions", type=int, default=1)
     p.add_argument("--run_name", type=str, default=None)
@@ -87,6 +91,9 @@ def parse_args(argv=None) -> tuple[ExperimentConfig, int]:
             num_rounds=a.comm_round,
             clients_per_round=a.client_num_per_round,
             eval_every=a.frequency_of_the_test,
+            robust_method=a.robust_method,
+            robust_norm_clip=a.robust_norm_clip,
+            robust_noise_stddev=a.robust_noise_stddev,
         ),
         seed=a.seed,
         run_name=a.run_name,

@@ -61,29 +61,26 @@ def create_model(cfg: ModelConfig) -> FedModel:
             cfg.input_shape,
             has_dropout=extra.get("dropout", 0.0) > 0,
         )
-    if name.startswith("resnet") and name.endswith("_gn"):
+    if name.startswith("resnet"):
         if name == "resnet18_gn":
             return FedModel(ResNet18GN(nc), cfg.input_shape)
-        depth = int(name[len("resnet"):-len("_gn")])
+        # name grammar: resnet<depth>[_gn][_s2d]; the norm default comes
+        # from the suffix, and extra=(("norm", "syncbn:data"),) overrides
+        # it for EVERY resnet variant (exact cross-shard BN on the named
+        # mesh axis — models.vision.SyncBatchNorm)
+        base = name[len("resnet"):]
+        s2d = base.endswith("_s2d")
+        if s2d:
+            base = base[: -len("_s2d")]
+        gn = base.endswith("_gn")
+        if gn:
+            base = base[: -len("_gn")]
+        depth = int(base)
+        norm = extra.get("norm", "gn" if gn else "bn")
         return FedModel(
-            ResNetCIFAR(depth, nc, norm="gn"), cfg.input_shape
-        )
-    if name.startswith("resnet") and name.endswith("_s2d"):
-        # TPU-optimized space-to-depth layout (see ResNetCIFAR docstring)
-        depth = int(name[len("resnet"):-len("_s2d")])
-        return FedModel(
-            ResNetCIFAR(depth, nc, norm="bn", space_to_depth=True),
+            ResNetCIFAR(depth, nc, norm=norm, space_to_depth=s2d),
             cfg.input_shape,
-            has_batch_stats=True,
-        )
-    if name.startswith("resnet"):
-        depth = int(name[len("resnet"):])
-        # extra=(("norm", "syncbn:data"),) opts into exact cross-shard BN
-        # on the named mesh axis (models.vision.SyncBatchNorm)
-        return FedModel(
-            ResNetCIFAR(depth, nc, norm=extra.get("norm", "bn")),
-            cfg.input_shape,
-            has_batch_stats=True,
+            has_batch_stats=norm != "gn",
         )
     if name == "mobilenet":
         return FedModel(
