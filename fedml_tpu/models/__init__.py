@@ -63,6 +63,12 @@ def create_model(cfg: ModelConfig) -> FedModel:
         )
     if name.startswith("resnet"):
         if name == "resnet18_gn":
+            if "norm" in extra:
+                raise ValueError(
+                    "resnet18_gn is the fixed GroupNorm ImageNet-style "
+                    "model (reference resnet_gn.py); a norm override does "
+                    "not apply — use resnet<depth> with extra norm instead"
+                )
             return FedModel(ResNet18GN(nc), cfg.input_shape)
         # name grammar: resnet<depth>[_gn][_s2d]; the norm default comes
         # from the suffix, and extra=(("norm", "syncbn:data"),) overrides
