@@ -175,6 +175,40 @@ def make_client_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
 
 
 # ---------------------------------------------------------------------------
+# Mixed-precision casting policy (shared by both local-update builders)
+# ---------------------------------------------------------------------------
+
+
+def _tree_to_dtype(t: Pytree, dtype) -> Pytree:
+    """Cast float leaves to the compute dtype (mixed precision: master
+    params and optimizer state stay f32, the network runs in bf16 — grads
+    flow back through the cast as f32)."""
+    cast = lambda a: (
+        a.astype(dtype) if jnp.issubdtype(a.dtype, jnp.floating) else a
+    )
+    return jax.tree.map(cast, t)
+
+
+def _static_vars_to_dtype(static_vars: dict, dtype) -> dict:
+    """batch_stats stay f32: the BN running-statistic EMA has relative
+    updates below bf16 resolution (momentum 0.99 -> 1% steps), so
+    quantizing the accumulator would freeze it. Flax computes the EMA in
+    the stats' own dtype — keeping the stored stats f32 keeps the
+    accumulation exact while activations run bf16."""
+    return {
+        k: (v if k == "batch_stats" else _tree_to_dtype(v, dtype))
+        for k, v in static_vars.items()
+    }
+
+
+def _tree_floats_back(t: Pytree, compute_dtype) -> Pytree:
+    cast = lambda a: (
+        a.astype(jnp.float32) if a.dtype == compute_dtype else a
+    )
+    return jax.tree.map(cast, t)
+
+
+# ---------------------------------------------------------------------------
 # Local update (the client hot loop, compiled)
 # ---------------------------------------------------------------------------
 
@@ -213,33 +247,9 @@ def build_local_update(
     compute_dtype = jnp.dtype(cfg.compute_dtype)
     mixed = compute_dtype != jnp.float32
 
-    def _to_compute(t):
-        """Cast float tensors to the compute dtype (mixed precision: master
-        params and optimizer state stay f32, the network runs in bf16 —
-        grads flow back through the cast as f32)."""
-        cast = lambda a: (
-            a.astype(compute_dtype)
-            if jnp.issubdtype(a.dtype, jnp.floating)
-            else a
-        )
-        return jax.tree.map(cast, t)
-
-    def _to_compute_vars(static_vars):
-        """batch_stats stay f32: the BN running-statistic EMA has relative
-        updates below bf16 resolution (momentum 0.99 -> 1% steps), so
-        quantizing the accumulator would freeze it. Flax computes the EMA in
-        the stats' own dtype — keeping the stored stats f32 keeps the
-        accumulation exact while activations run bf16."""
-        return {
-            k: (v if k == "batch_stats" else _to_compute(v))
-            for k, v in static_vars.items()
-        }
-
-    def _to_f32(t):
-        cast = lambda a: (
-            a.astype(jnp.float32) if a.dtype == compute_dtype else a
-        )
-        return jax.tree.map(cast, t)
+    _to_compute = lambda t: _tree_to_dtype(t, compute_dtype)
+    _to_compute_vars = lambda sv: _static_vars_to_dtype(sv, compute_dtype)
+    _to_f32 = lambda t: _tree_floats_back(t, compute_dtype)
 
     def loss_fn(params, static_vars, x_b, y_b, w_b, rng, global_params):
         """Weighted-SUM loss normalized by the psum-ed weight total, so that
@@ -373,6 +383,188 @@ def build_local_update(
         return variables, n_k, msums
 
     return local_update
+
+
+def cohort_update_supported(model: FedModel, cfg: TrainConfig) -> bool:
+    """Whether the cohort-grouped local update can replace
+    ``vmap(local_update)`` exactly. Requires architecture support (see
+    :meth:`FedModel.supports_cohort`) and a client optimizer whose state
+    leaves all carry the per-client leading axis (sgd/momentum; adam's
+    scalar step count cannot be gated per client in stacked form).
+    Gradient clipping is excluded: ``optax.clip_by_global_norm`` over the
+    stacked tree would compute one cohort-joint norm, not per-client
+    norms."""
+    return (
+        model.supports_cohort()
+        and cfg.optimizer == "sgd"
+        and cfg.clip_norm == 0
+    )
+
+
+def build_cohort_local_update(
+    model: FedModel,
+    task: Task,
+    cfg: TrainConfig,
+    batch_size: int,
+    max_n: int,
+    cohort: int,
+):
+    """Cohort-major local update: the whole sampled cohort trains inside
+    ONE network application per step (:mod:`fedml_tpu.models.cohort`),
+    instead of ``vmap`` of the per-client update.
+
+    Same contract as ``vmap(build_local_update(...), in_axes=(None, 0, 0,
+    None, None, 0))`` — takes (global_vars, idx_rows [C, max_n], mask_rows,
+    x, y, rngs [C]), returns (stacked_vars, n_k [C], metric sums with [C]
+    leaves) — and the same numerics: per-client batch order, gradients,
+    masking, and BN statistics agree to f32 round-off (the grouped network
+    is the per-client network re-laid-out; reductions reassociate, so
+    equality is not bitwise — see tests/test_cohort_conv.py's chaos
+    calibration). It exists purely because XLA lowers
+    one wide grouped conv far better than a batched-kernel conv on TPU
+    (measured ~3x on the ResNet-56 round; see
+    :mod:`fedml_tpu.ops.cohort_conv` for numbers).
+
+    Per-client losses are summed, so ``d(total)/d(params_c)`` is exactly
+    client c's gradient. A fully-padded batch contributes zero gradient
+    AND is where-gated per client (params, optimizer state, and
+    batch_stats all carry the leading [C] axis outside the network), so
+    padded steps remain strict no-ops, matching the vmapped path.
+    """
+    assert max_n % batch_size == 0, (max_n, batch_size)
+    steps_per_epoch = max_n // batch_size
+    C = cohort
+    opt = make_client_optimizer(cfg)
+    compute_dtype = jnp.dtype(cfg.compute_dtype)
+    mixed = compute_dtype != jnp.float32
+
+    _to_compute = lambda t: _tree_to_dtype(t, compute_dtype)
+    _to_f32 = lambda t: _tree_floats_back(t, compute_dtype)
+
+    def loss_fn(stacked_params, static_stacked, x_cb, y_cb, w_cb, rng,
+                global_params):
+        if mixed:
+            variables = {
+                **_static_vars_to_dtype(static_stacked, compute_dtype),
+                "params": _to_compute(stacked_params),
+            }
+            x_cb = _to_compute(x_cb)
+        else:
+            variables = {**static_stacked, "params": stacked_params}
+        logits, new_vars = model.apply_cohort_train(variables, x_cb, rng)
+        if mixed:
+            logits = logits.astype(jnp.float32)
+            new_vars = _to_f32(new_vars)
+        sums = jax.vmap(task.metric_sums)(logits, y_cb, w_cb)  # [C] leaves
+        loss = jnp.sum(
+            sums["loss_sum"] / jnp.maximum(sums["w_sum"], 1.0)
+        )
+        if cfg.prox_mu > 0:
+            diff = jax.tree.map(
+                lambda p, g: p - g[None], stacked_params, global_params
+            )
+            loss = loss + 0.5 * cfg.prox_mu * T.tree_dot(diff, diff)
+        return loss, (new_vars, sums)
+
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+    def cohort_update(global_vars, idx_rows, mask_rows, x, y, rngs):
+        global_params = global_vars["params"]
+        stacked0 = jax.tree.map(
+            lambda v: jnp.broadcast_to(v[None], (C,) + v.shape), global_vars
+        )
+
+        def epoch_body(carry, ekeys):
+            variables, opt_state, msums = carry
+
+            def perm_for(ekey, mask_row):
+                perm = jax.random.permutation(ekey, max_n)
+                order = jnp.argsort(1.0 - mask_row[perm], stable=True)
+                return perm[order]
+
+            perms = jax.vmap(perm_for)(ekeys, mask_rows)  # [C, max_n]
+
+            def step_body(carry2, step):
+                variables, opt_state, msums = carry2
+                take = jax.lax.dynamic_slice_in_dim(
+                    perms, step * batch_size, batch_size, axis=1
+                )
+                b_idx = jnp.take_along_axis(idx_rows, take, axis=1)
+                w_b = jnp.take_along_axis(mask_rows, take, axis=1)
+                x_b = jnp.take(x, b_idx, axis=0)
+                y_b = jnp.take(y, b_idx, axis=0)
+                skey = jax.random.fold_in(ekeys[0], step)
+                params = variables["params"]
+                static_vars = {
+                    k: v for k, v in variables.items() if k != "params"
+                }
+                (_, (new_vars, sums)), grads = grad_fn(
+                    params, static_vars, x_b, y_b, w_b, skey, global_params
+                )
+                updates, new_opt_state = opt.update(grads, opt_state, params)
+                new_params = optax.apply_updates(params, updates)
+                valid = sums["w_sum"] > 0  # [C]
+                sel = lambda n, o: jax.tree.map(
+                    lambda a, b: jnp.where(
+                        valid.reshape((C,) + (1,) * (a.ndim - 1)), a, b
+                    ),
+                    n,
+                    o,
+                )
+                new_variables = {**new_vars, "params": new_params}
+                out_vars = sel(new_variables, variables)
+                out_opt = sel(new_opt_state, opt_state)
+                msums = {k: msums[k] + sums[k] for k in msums}
+                return (out_vars, out_opt, msums), None
+
+            # Dynamic trip count: padded trailing steps are exact no-ops
+            # (zero grads + where-gating), so running only
+            # ceil(max cohort n_k / B) steps is bitwise-identical and
+            # skips the padding waste entirely — the worst client in the
+            # POPULATION no longer taxes every round, only the worst in
+            # the sampled cohort. With hetero-LDA partitions this is the
+            # single largest round-time lever (population max can be many
+            # times the cohort max at 1000-client scale).
+            def fori_body(step, carry2):
+                carry2, _ = step_body(carry2, step)
+                return carry2
+
+            variables, opt_state, msums = jax.lax.fori_loop(
+                0, cohort_steps, fori_body, (variables, opt_state, msums)
+            )
+            return (variables, opt_state, msums), None
+
+        cohort_steps = jnp.minimum(
+            jnp.ceil(jnp.max(jnp.sum(mask_rows, axis=1)) / batch_size)
+            .astype(jnp.int32),
+            steps_per_epoch,
+        )
+        opt_state = jax.vmap(opt.init)(stacked0["params"])
+        msums0 = jax.tree.map(
+            lambda s: jnp.zeros((C,), s.dtype), zero_sums()
+        )
+        # per-client epoch keys, identical to the vmapped path's
+        # fold_in(rng_c, e) derivation so trajectories match exactly
+        ekeys = jax.vmap(
+            lambda r: jax.vmap(
+                lambda e: jax.random.fold_in(r, e)
+            )(jnp.arange(cfg.epochs))
+        )(rngs)  # [C, epochs]
+        if cfg.epochs <= 2:
+            carry = (stacked0, opt_state, msums0)
+            for e in range(cfg.epochs):
+                carry, _ = epoch_body(carry, ekeys[:, e])
+            variables, _, msums = carry
+        else:
+            (variables, _, msums), _ = jax.lax.scan(
+                epoch_body,
+                (stacked0, opt_state, msums0),
+                jnp.moveaxis(ekeys, 1, 0),
+            )
+        n_k = jnp.sum(mask_rows, axis=1)
+        return variables, n_k, msums
+
+    return cohort_update
 
 
 # ---------------------------------------------------------------------------

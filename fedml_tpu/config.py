@@ -72,10 +72,16 @@ class TrainConfig:
     # optimizer state stay f32; activations/grads computed in bf16 on the
     # MXU — the TPU-native speed path, ~2x on bandwidth-bound models)
     compute_dtype: str = "float32"
-    # unroll factor for the per-step lax.scan inside local_update (1 = plain
-    # scan). Unrolling removes loop-carry layout copies at the cost of
-    # program size; the headline bench uses full unroll.
+    # unroll factor for the per-step lax.scan inside the VMAPPED
+    # local_update (1 = plain scan). Unrolling removes loop-carry layout
+    # copies at the cost of program size. The cohort-fused path ignores
+    # this: its step loop has a data-dependent trip count (padded steps
+    # are skipped), which cannot unroll.
     scan_unroll: int = 1
+    # run the sampled cohort as ONE cohort-grouped network when the model
+    # and optimizer support it (same numerics, much faster conv lowering
+    # on TPU — fedml_tpu.models.cohort). False = always vmap per client.
+    cohort_fused: bool = True
 
 
 @dataclasses.dataclass(frozen=True)

@@ -55,5 +55,43 @@ class FedModel:
     def apply_eval(self, variables: Variables, x: jax.Array) -> jax.Array:
         return self.module.apply(variables, x, train=False)
 
+    # -- cohort-grouped fast path (see fedml_tpu.models.cohort) ------------
+
+    def supports_cohort(self) -> bool:
+        """Whether this architecture can run the whole sampled cohort as
+        one cohort-grouped network (conv zoo modules expose a ``cohort``
+        width-multiplier field). Dropout is excluded: the grouped form
+        draws one mask over the widened activations, which changes the
+        per-client noise stream vs the vmapped form."""
+        return (
+            getattr(self.module, "cohort", None) == 1
+            and not self.has_dropout
+        )
+
+    def apply_cohort_train(
+        self, stacked_vars: Variables, x: jax.Array, rng: jax.Array
+    ) -> tuple[jax.Array, Variables]:
+        """Train-mode forward of C clients at once in cohort-grouped form.
+
+        ``stacked_vars`` has leading client axis C on every leaf; ``x`` is
+        ``[C, B, H, W, cin]``. Returns (logits ``[C, B, K]``, updated
+        stacked variables). Numerically identical to
+        ``vmap(apply_train)`` — the grouped network IS the per-client
+        network, re-laid-out (channel groups = clients)."""
+        from fedml_tpu.models.cohort import fat_to_stack, stack_to_fat
+
+        C = x.shape[0]
+        module = self.module.clone(cohort=C)
+        fat = stack_to_fat(stacked_vars, C)
+        xg = jnp.moveaxis(x, 0, 3).reshape(x.shape[1:4] + (-1,))
+        rngs = {"dropout": rng} if self.has_dropout else None
+        if self.has_batch_stats:
+            logits, mutated = module.apply(
+                fat, xg, train=True, rngs=rngs, mutable=["batch_stats"]
+            )
+            return logits, {**stacked_vars, **fat_to_stack(mutated, C)}
+        logits = module.apply(fat, xg, train=True, rngs=rngs)
+        return logits, stacked_vars
+
 
 LossFn = Callable[[jax.Array, jax.Array, jax.Array], jax.Array]

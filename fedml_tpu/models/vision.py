@@ -17,6 +17,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from fedml_tpu.models.cohort import dense as _cohort_dense
+from fedml_tpu.ops.cohort_conv import Conv2D
+
 
 class LogisticRegression(nn.Module):
     """Flatten -> dense (reference ``fedml_api/model/linear/lr.py:4``)."""
@@ -34,16 +37,27 @@ class CNNOriginalFedAvg(nn.Module):
     (reference ``fedml_api/model/cv/cnn.py:5``)."""
 
     num_classes: int = 62
+    cohort: int = 1
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        x = nn.Conv(32, (5, 5), padding="SAME")(x)
+        co = self.cohort
+        x = Conv2D(32 * co, (5, 5), padding="SAME",
+                   feature_group_count=co)(x)
         x = nn.max_pool(nn.relu(x), (2, 2), (2, 2))
-        x = nn.Conv(64, (5, 5), padding="SAME")(x)
+        x = Conv2D(64 * co, (5, 5), padding="SAME",
+                   feature_group_count=co)(x)
         x = nn.max_pool(nn.relu(x), (2, 2), (2, 2))
-        x = x.reshape((x.shape[0], -1))
-        x = nn.relu(nn.Dense(512)(x))
-        return nn.Dense(self.num_classes)(x)
+        if co > 1:
+            # per-client flatten in base (H, W, ch) order
+            b, h, w, cch = x.shape
+            x = x.reshape(b, h, w, co, cch // co)
+            x = x.transpose(0, 3, 1, 2, 4).reshape(b, co, -1)
+        else:
+            x = x.reshape((x.shape[0], -1))
+        x = nn.relu(_cohort_dense(512, co, "fc1")(x))
+        y = _cohort_dense(self.num_classes, co, "head")(x)
+        return y.transpose(1, 0, 2) if co > 1 else y
 
 
 class CNNDropOut(nn.Module):
@@ -53,8 +67,8 @@ class CNNDropOut(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        x = nn.relu(nn.Conv(32, (3, 3), padding="VALID")(x))
-        x = nn.relu(nn.Conv(64, (3, 3), padding="VALID")(x))
+        x = nn.relu(Conv2D(32, (3, 3), padding="VALID")(x))
+        x = nn.relu(Conv2D(64, (3, 3), padding="VALID")(x))
         x = nn.max_pool(x, (2, 2), (2, 2))
         x = nn.Dropout(0.25, deterministic=not train)(x)
         x = x.reshape((x.shape[0], -1))
@@ -76,7 +90,7 @@ class CNNParameterised(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool = False):
         for ch in self.conv_channels:
-            x = nn.relu(nn.Conv(ch, (3, 3), padding="SAME")(x))
+            x = nn.relu(Conv2D(ch, (3, 3), padding="SAME")(x))
             x = nn.max_pool(x, (2, 2), (2, 2))
         x = x.reshape((x.shape[0], -1))
         for d in self.dense_sizes:
@@ -86,11 +100,15 @@ class CNNParameterised(nn.Module):
         return nn.Dense(self.num_classes)(x)
 
 
-def _norm(kind: str, train: bool):
+def _norm(kind: str, train: bool, cohort: int = 1):
     if kind == "bn":
+        # cohort-grouped layout: per-channel stats are already per-client
         return nn.BatchNorm(use_running_average=not train, momentum=0.9)
     if kind == "gn":
-        return nn.GroupNorm(num_groups=2)
+        # widened channels are c-major, so scaling the group count keeps
+        # every group inside one client's block (groups must not mix
+        # clients)
+        return nn.GroupNorm(num_groups=2 * cohort)
     if kind.startswith("syncbn"):
         # "syncbn:<axis>" = exact cross-shard BN over that mesh axis
         # (reference SynchronizedBatchNorm; see SyncBatchNorm below).
@@ -123,21 +141,25 @@ class BasicBlock(nn.Module):
     channels: int
     stride: int = 1
     norm: str = "bn"
+    cohort: int = 1
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        ch, co = self.channels * self.cohort, self.cohort
         residual = x
-        y = nn.Conv(self.channels, (3, 3), (self.stride, self.stride),
-                    padding="SAME", use_bias=False)(x)
-        y = _norm(self.norm, train)(y)
+        y = Conv2D(ch, (3, 3), (self.stride, self.stride),
+                   padding="SAME", use_bias=False,
+                   feature_group_count=co)(x)
+        y = _norm(self.norm, train, co)(y)
         y = nn.relu(y)
-        y = nn.Conv(self.channels, (3, 3), padding="SAME", use_bias=False)(y)
-        y = _norm(self.norm, train)(y)
+        y = Conv2D(ch, (3, 3), padding="SAME", use_bias=False,
+                   feature_group_count=co)(y)
+        y = _norm(self.norm, train, co)(y)
         if residual.shape != y.shape:
-            residual = nn.Conv(self.channels, (1, 1),
-                               (self.stride, self.stride),
-                               use_bias=False)(x)
-            residual = _norm(self.norm, train)(residual)
+            residual = Conv2D(ch, (1, 1),
+                              (self.stride, self.stride),
+                              use_bias=False, feature_group_count=co)(x)
+            residual = _norm(self.norm, train, co)(residual)
         return nn.relu(y + residual)
 
 
@@ -160,30 +182,39 @@ class ResNetCIFAR(nn.Module):
     norm: str = "bn"
     width: int = 16
     space_to_depth: bool = False
+    # cohort > 1 = cohort-grouped mode (see fedml_tpu.models.cohort):
+    # input [B, H, W, C*cin] with client blocks c-major, output [C, B, K]
+    cohort: int = 1
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         n = (self.depth - 2) // 6
+        co = self.cohort
         if self.space_to_depth:
-            b, h, w, c = x.shape
-            x = x.reshape(b, h // 2, 2, w // 2, 2, c)
-            x = x.transpose(0, 1, 3, 2, 4, 5).reshape(
-                b, h // 2, w // 2, 4 * c
+            b, h, w, cc = x.shape
+            c = cc // co
+            # keep client blocks outermost in the channel dim so grouped
+            # convs stay client-aligned: [..., C, 2, 2, c] -> C*(4c)
+            x = x.reshape(b, h // 2, 2, w // 2, 2, co, c)
+            x = x.transpose(0, 1, 3, 5, 2, 4, 6).reshape(
+                b, h // 2, w // 2, co * 4 * c
             )
             widths = (4 * self.width, 2 * self.width, 4 * self.width)
             strides = (1, 1, 2)
         else:
             widths = (self.width, 2 * self.width, 4 * self.width)
             strides = (1, 2, 2)
-        x = nn.Conv(widths[0], (3, 3), padding="SAME", use_bias=False)(x)
-        x = _norm(self.norm, train)(x)
+        x = Conv2D(widths[0] * co, (3, 3), padding="SAME", use_bias=False,
+                   feature_group_count=co)(x)
+        x = _norm(self.norm, train, co)(x)
         x = nn.relu(x)
         for stage, (ch, st) in enumerate(zip(widths, strides)):
             for blk in range(n):
                 stride = st if (stage > 0 and blk == 0) else 1
-                x = BasicBlock(ch, stride, self.norm)(x, train)
+                x = BasicBlock(ch, stride, self.norm, co)(x, train)
         x = jnp.mean(x, axis=(1, 2))
-        return nn.Dense(self.num_classes)(x)
+        y = _cohort_dense(self.num_classes, co, "head")(x)
+        return y.transpose(1, 0, 2) if co > 1 else y
 
 
 class ResNet18GN(nn.Module):
@@ -191,18 +222,22 @@ class ResNet18GN(nn.Module):
     (reference ``fedml_api/model/cv/resnet_gn.py:108``)."""
 
     num_classes: int = 100
+    cohort: int = 1
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        x = nn.Conv(64, (3, 3), padding="SAME", use_bias=False)(x)
-        x = nn.GroupNorm(num_groups=2)(x)
+        co = self.cohort
+        x = Conv2D(64 * co, (3, 3), padding="SAME", use_bias=False,
+                   feature_group_count=co)(x)
+        x = nn.GroupNorm(num_groups=2 * co)(x)
         x = nn.relu(x)
         for stage, ch in enumerate((64, 128, 256, 512)):
             for blk in range(2):
                 stride = 2 if (stage > 0 and blk == 0) else 1
-                x = BasicBlock(ch, stride, norm="gn")(x, train)
+                x = BasicBlock(ch, stride, norm="gn", cohort=co)(x, train)
         x = jnp.mean(x, axis=(1, 2))
-        return nn.Dense(self.num_classes)(x)
+        y = _cohort_dense(self.num_classes, co, "head")(x)
+        return y.transpose(1, 0, 2) if co > 1 else y
 
 
 class DepthwiseSeparable(nn.Module):
@@ -212,12 +247,12 @@ class DepthwiseSeparable(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool = False):
         in_ch = x.shape[-1]
-        x = nn.Conv(in_ch, (3, 3), (self.stride, self.stride),
+        x = Conv2D(in_ch, (3, 3), (self.stride, self.stride),
                     padding="SAME", feature_group_count=in_ch,
                     use_bias=False)(x)
         x = nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
         x = nn.relu(x)
-        x = nn.Conv(self.channels, (1, 1), use_bias=False)(x)
+        x = Conv2D(self.channels, (1, 1), use_bias=False)(x)
         x = nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
         return nn.relu(x)
 
@@ -233,7 +268,7 @@ class MobileNet(nn.Module):
         def c(ch):
             return max(8, int(ch * self.width_mult))
 
-        x = nn.Conv(c(32), (3, 3), (1, 1), padding="SAME", use_bias=False)(x)
+        x = Conv2D(c(32), (3, 3), (1, 1), padding="SAME", use_bias=False)(x)
         x = nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
         x = nn.relu(x)
         plan = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
@@ -257,7 +292,7 @@ class VGG(nn.Module):
             if p == "M":
                 x = nn.max_pool(x, (2, 2), (2, 2))
             else:
-                x = nn.relu(nn.Conv(int(p), (3, 3), padding="SAME")(x))
+                x = nn.relu(Conv2D(int(p), (3, 3), padding="SAME")(x))
         x = x.reshape((x.shape[0], -1))
         x = nn.relu(nn.Dense(512)(x))
         return nn.Dense(self.num_classes)(x)

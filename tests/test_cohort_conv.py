@@ -1,0 +1,293 @@
+"""Cohort-grouped convolution + cohort-fused local update.
+
+Covers the two layers of the TPU cohort fast path:
+
+- :mod:`fedml_tpu.ops.cohort_conv` — the primitive triple must match
+  ``lax.conv_general_dilated`` exactly under every transform order the
+  framework uses (vmap-of-grad is the hot one, plus nested vmap for
+  hierarchical FL and second order for completeness).
+- :mod:`fedml_tpu.models.cohort` + ``build_cohort_local_update`` — the
+  cohort-grouped network must be the per-client network re-laid-out:
+  single applications agree to f32 round-off; multi-step SGD
+  trajectories are equal to within f32 chaos (calibrated against a pure
+  scan-unroll scheduling change, which produces the same divergence
+  class).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.config import (
+    DataConfig,
+    ExperimentConfig,
+    FedConfig,
+    ModelConfig,
+    TrainConfig,
+)
+from fedml_tpu.ops.cohort_conv import cohort_conv
+from fedml_tpu.models import create_model
+
+
+def _lax_ref(x, w, s=(1, 1), p="SAME", d=(1, 1), g=1):
+    return jax.lax.conv_general_dilated(
+        x, w, s, p, rhs_dilation=d,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"), feature_group_count=g,
+    )
+
+
+def _mk(C=3, B=4, H=8, W=8, ci=5, co=7, seed=0):
+    x = jax.random.normal(jax.random.key(seed), (C, B, H, W, ci))
+    w = jax.random.normal(jax.random.key(seed + 1), (C, 3, 3, ci, co)) * 0.2
+    return x, w
+
+
+def test_fwd_matches_lax_all_batch_combos():
+    x, w = _mk()
+    assert jnp.array_equal(cohort_conv(x[0], w[0]), _lax_ref(x[0], w[0]))
+    assert jnp.array_equal(
+        jax.vmap(cohort_conv)(x, w), jax.vmap(_lax_ref)(x, w)
+    )
+    # x-batched only (shared kernel) and w-batched only (shared input)
+    np.testing.assert_array_equal(
+        jax.vmap(lambda xi: cohort_conv(xi, w[0]))(x),
+        jax.vmap(lambda xi: _lax_ref(xi, w[0]))(x),
+    )
+    np.testing.assert_allclose(
+        jax.vmap(lambda wi: cohort_conv(x[0], wi))(w),
+        jax.vmap(lambda wi: _lax_ref(x[0], wi))(w),
+        atol=1e-6,
+    )
+
+
+@pytest.mark.parametrize(
+    "kwargs",
+    [
+        {},
+        {"strides": (2, 2)},
+        {"padding": "VALID"},
+        {"strides": (2, 2), "padding": "VALID"},
+        {"rhs_dilation": (2, 2)},
+    ],
+)
+def test_vmap_grad_matches_lax(kwargs):
+    """The hot path: vmap(grad(f)) over both operands, every conv config
+    the zoo uses."""
+    x, w = _mk()
+    s = kwargs.get("strides", (1, 1))
+    p = kwargs.get("padding", "SAME")
+    d = kwargs.get("rhs_dilation", (1, 1))
+
+    def loss_c(xi, wi):
+        return (cohort_conv(xi, wi, **kwargs).astype(jnp.float32) ** 2).sum()
+
+    def loss_r(xi, wi):
+        return (_lax_ref(xi, wi, s, p, d).astype(jnp.float32) ** 2).sum()
+
+    gc = jax.jit(jax.vmap(jax.grad(loss_c, argnums=(0, 1))))(x, w)
+    gr = jax.jit(jax.vmap(jax.grad(loss_r, argnums=(0, 1))))(x, w)
+    for a, b in zip(jax.tree.leaves(gc), jax.tree.leaves(gr)):
+        # same math, different XLA reduction schedules -> f32 round-off
+        np.testing.assert_allclose(a, b, atol=2e-5, rtol=1e-5)
+
+
+def test_depthwise_grad_matches_lax():
+    C, ci = 3, 5
+    x, _ = _mk(ci=ci)
+    wd = jax.random.normal(jax.random.key(7), (C, 3, 3, 1, ci)) * 0.2
+    gc = jax.vmap(
+        jax.grad(
+            lambda xi, wi: (
+                cohort_conv(xi, wi, feature_group_count=ci) ** 2
+            ).sum(),
+            argnums=(0, 1),
+        )
+    )(x, wd)
+    gr = jax.vmap(
+        jax.grad(
+            lambda xi, wi: (_lax_ref(xi, wi, g=ci) ** 2).sum(),
+            argnums=(0, 1),
+        )
+    )(x, wd)
+    for a, b in zip(jax.tree.leaves(gc), jax.tree.leaves(gr)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_second_order_and_nested_vmap():
+    x, w = _mk()
+
+    def h(f):
+        return jax.grad(
+            lambda wi: jnp.sum(
+                jax.grad(lambda w2: (f(x[0], w2) ** 2).sum())(wi) ** 2
+            )
+        )(w[0])
+
+    np.testing.assert_array_equal(h(cohort_conv), h(_lax_ref))
+
+    xx = jnp.stack([x, x + 1.0])
+    ww = jnp.stack([w, w * 0.5])
+    n1 = jax.vmap(
+        jax.vmap(jax.grad(lambda a, b: (cohort_conv(a, b) ** 2).sum()))
+    )(xx, ww)
+    n2 = jax.vmap(
+        jax.vmap(jax.grad(lambda a, b: (_lax_ref(a, b) ** 2).sum()))
+    )(xx, ww)
+    np.testing.assert_array_equal(n1, n2)
+
+
+# ---------------------------------------------------------------------------
+# Cohort-grouped model application
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "name", ["resnet8", "resnet8_gn", "resnet8_s2d", "cnn_fedavg"]
+)
+def test_apply_cohort_equals_vmap(name):
+    model = create_model(
+        ModelConfig(name=name, num_classes=10, input_shape=(16, 16, 3))
+    )
+    assert model.supports_cohort()
+    C = 3
+    stacked = jax.jit(jax.vmap(model.init))(
+        jax.random.split(jax.random.key(0), C)
+    )
+    x = jax.random.normal(jax.random.key(9), (C, 4, 16, 16, 3))
+    rng = jax.random.key(5)
+    lv, vv = jax.jit(
+        jax.vmap(lambda v, xi: model.apply_train(v, xi, rng))
+    )(stacked, x)
+    lc, vc = jax.jit(
+        lambda s, xi: model.apply_cohort_train(s, xi, rng)
+    )(stacked, x)
+    np.testing.assert_allclose(lv, lc, atol=2e-6)
+    for a, b in zip(jax.tree.leaves(vv), jax.tree.leaves(vc)):
+        np.testing.assert_allclose(a, b, atol=2e-6)
+
+
+@pytest.mark.slow
+def test_cohort_round_exact_for_stateless_net():
+    """End-to-end FedAvg rounds: for a BN-free net (no stat-update
+    reassociation) the cohort-fused path reproduces the vmapped path to
+    f32 round-off over full rounds, including ragged clients exercising
+    the dynamic trip count and padded-step gating."""
+
+    def run(cohort_fused):
+        cfg = ExperimentConfig(
+            data=DataConfig(
+                dataset="fake_cifar10", num_clients=12,
+                partition_method="hetero", partition_alpha=0.5,
+                batch_size=8, seed=0, dataset_r=0.1,
+            ),
+            model=ModelConfig(
+                name="cnn_fedavg", num_classes=10, input_shape=(32, 32, 3)
+            ),
+            train=TrainConfig(
+                lr=0.05, epochs=2, momentum=0.9, prox_mu=0.01,
+                cohort_fused=cohort_fused,
+            ),
+            fed=FedConfig(
+                num_rounds=2, clients_per_round=4, eval_every=10**9
+            ),
+            seed=0,
+        )
+        from fedml_tpu.algorithms.fedavg import FedAvgSim
+        from fedml_tpu.data.loaders import load_dataset
+
+        sim = FedAvgSim(create_model(cfg.model), load_dataset(cfg.data), cfg)
+        assert (sim._cohort_update is not None) == cohort_fused
+        state = sim.init()
+        for _ in range(2):
+            state, m = sim.run_round(state)
+        return state
+
+    s1, s2 = run(True), run(False)
+    for a, b in zip(jax.tree.leaves(s1.variables), jax.tree.leaves(s2.variables)):
+        np.testing.assert_allclose(a, b, atol=1e-5)
+
+
+@pytest.mark.slow
+def test_cohort_one_step_grads_close_with_bn():
+    """With BN the backward pass reassociates reductions, so exactness
+    holds only per-application; one optimizer step of gradients must
+    still agree to f32 round-off."""
+    import optax
+
+    model = create_model(
+        ModelConfig(name="resnet8", num_classes=10, input_shape=(16, 16, 3))
+    )
+    C = 3
+    stacked = jax.jit(jax.vmap(model.init))(
+        jax.random.split(jax.random.key(0), C)
+    )
+    x = jax.random.normal(jax.random.key(9), (C, 8, 16, 16, 3))
+    y = jax.random.randint(jax.random.key(10), (C, 8), 0, 10)
+    rng = jax.random.key(5)
+
+    def loss_v(params, stats, xi, yi):
+        out, _ = model.apply_train(
+            {"params": params, "batch_stats": stats}, xi, rng
+        )
+        return optax.softmax_cross_entropy_with_integer_labels(out, yi).mean()
+
+    gv = jax.jit(jax.vmap(jax.grad(loss_v)))(
+        stacked["params"], stacked["batch_stats"], x, y
+    )
+
+    def loss_c(sp):
+        logits, _ = model.apply_cohort_train({**stacked, "params": sp}, x, rng)
+        ce = jax.vmap(
+            lambda l, yy: optax.softmax_cross_entropy_with_integer_labels(
+                l, yy
+            ).mean()
+        )(logits, y)
+        return jnp.sum(ce)
+
+    gc = jax.jit(jax.grad(loss_c))(stacked["params"])
+    for a, b in zip(jax.tree.leaves(gc), jax.tree.leaves(gv)):
+        np.testing.assert_allclose(a, b, atol=1e-5)
+
+
+def test_dynamic_trip_count_skips_padding_exactly():
+    """A cohort whose largest client needs fewer steps than the padded
+    maximum must produce identical results to the vmapped path (which
+    always runs the padded maximum) — padded steps are strict no-ops."""
+    from fedml_tpu.algorithms.base import (
+        build_cohort_local_update,
+        build_local_update,
+        make_task,
+    )
+
+    model = create_model(
+        ModelConfig(name="cnn_fedavg", num_classes=10, input_shape=(8, 8, 3))
+    )
+    task = make_task("classification")
+    cfg = TrainConfig(lr=0.05, epochs=1, momentum=0.9)
+    B, max_n, C = 4, 16, 3  # 4 padded steps
+    lu = build_local_update(model, task, cfg, B, max_n)
+    cu = build_cohort_local_update(model, task, cfg, B, max_n, C)
+
+    g = model.init(jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (40, 8, 8, 3))
+    y = jax.random.randint(jax.random.key(2), (40,), 0, 10)
+    rng = jax.random.key(3)
+    # ragged: 5, 8, 2 real samples — cohort max steps = 2 of 4
+    idx = jnp.zeros((C, max_n), jnp.int32)
+    mask = jnp.zeros((C, max_n))
+    counts = [5, 8, 2]
+    for c, n in enumerate(counts):
+        idx = idx.at[c, :n].set(jnp.arange(n) + 10 * c)
+        mask = mask.at[c, :n].set(1.0)
+    rngs = jax.random.split(rng, C)
+
+    ov = jax.jit(
+        jax.vmap(lu, in_axes=(None, 0, 0, None, None, 0))
+    )(g, idx, mask, x, y, rngs)
+    oc = jax.jit(cu)(g, idx, mask, x, y, rngs)
+    np.testing.assert_array_equal(np.asarray(oc[1]), np.asarray(ov[1]))
+    for a, b in zip(jax.tree.leaves(oc), jax.tree.leaves(ov)):
+        np.testing.assert_allclose(a, b, atol=1e-5)
