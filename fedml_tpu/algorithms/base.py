@@ -213,6 +213,20 @@ def _tree_floats_back(t: Pytree, compute_dtype) -> Pytree:
 # ---------------------------------------------------------------------------
 
 
+def _padded_perm(ekey: jax.Array, mask_row: jax.Array, max_n: int):
+    """One epoch's batch order for one client: shuffle, then stable-sort
+    so real samples occupy the first ceil(n_k/B) batches (shuffled among
+    themselves) and trailing batches are fully padding. A small client
+    thus takes exactly its serial-equivalent number of optimizer steps
+    instead of scattering 1-2 real samples into many full-lr steps — and
+    FedNova's tau = ceil(n_k/B)*epochs stays exact. SHARED by the vmapped
+    and cohort-fused local updates: their trajectory equality depends on
+    this ordering being identical."""
+    perm = jax.random.permutation(ekey, max_n)
+    order = jnp.argsort(1.0 - mask_row[perm], stable=True)
+    return perm[order]
+
+
 def build_local_update(
     model: FedModel,
     task: Task,
@@ -286,15 +300,7 @@ def build_local_update(
 
         def epoch_body(carry, ekey):
             variables, opt_state, msums = carry
-            # Shuffle, then stable-sort so real samples occupy the first
-            # ceil(n_k/B) batches (shuffled among themselves) and trailing
-            # batches are fully padding. This makes a small client take
-            # exactly its serial-equivalent number of optimizer steps
-            # instead of scattering 1-2 real samples into many full-lr
-            # steps — and keeps FedNova's tau = ceil(n_k/B)*epochs exact.
-            perm = jax.random.permutation(ekey, max_n)
-            order = jnp.argsort(1.0 - mask_row[perm], stable=True)
-            perm = perm[order]
+            perm = _padded_perm(ekey, mask_row, max_n)
 
             def step_body(carry2, step):
                 variables, opt_state, msums = carry2
@@ -477,12 +483,9 @@ def build_cohort_local_update(
         def epoch_body(carry, ekeys):
             variables, opt_state, msums = carry
 
-            def perm_for(ekey, mask_row):
-                perm = jax.random.permutation(ekey, max_n)
-                order = jnp.argsort(1.0 - mask_row[perm], stable=True)
-                return perm[order]
-
-            perms = jax.vmap(perm_for)(ekeys, mask_rows)  # [C, max_n]
+            perms = jax.vmap(lambda k, m: _padded_perm(k, m, max_n))(
+                ekeys, mask_rows
+            )  # [C, max_n]
 
             def step_body(carry2, step):
                 variables, opt_state, msums = carry2

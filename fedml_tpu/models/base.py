@@ -81,6 +81,17 @@ class FedModel:
         from fedml_tpu.models.cohort import fat_to_stack, stack_to_fat
 
         C = x.shape[0]
+        if C == 1:
+            # degenerate cohort (e.g. one client per mesh shard): the
+            # widened network IS the base network; dense scopes store
+            # stacked [1, f, o] kernels the base head can't consume, so
+            # squeeze through the ordinary per-client apply instead
+            squeezed = jax.tree.map(lambda v: v[0], stacked_vars)
+            logits, new_vars = self.apply_train(squeezed, x[0], rng)
+            return (
+                logits[None],
+                jax.tree.map(lambda v: v[None], new_vars),
+            )
         module = self.module.clone(cohort=C)
         fat = stack_to_fat(stacked_vars, C)
         xg = jnp.moveaxis(x, 0, 3).reshape(x.shape[1:4] + (-1,))

@@ -40,7 +40,12 @@ from jax.sharding import Mesh, PartitionSpec as P
 from fedml_tpu.config import ExperimentConfig
 from fedml_tpu.core import random as R
 from fedml_tpu.data.federated import FederatedData, shard_client_banks
-from fedml_tpu.algorithms.base import build_local_update, finalize_sums
+from fedml_tpu.algorithms.base import (
+    build_cohort_local_update,
+    build_local_update,
+    cohort_update_supported,
+    finalize_sums,
+)
 from fedml_tpu.algorithms.fedavg import (
     FedAvgSim,
     ServerState,
@@ -92,6 +97,22 @@ class ShardedFedAvg(FedAvgSim):
                 data_axis=self.data_axis,
                 data_axis_size=self.n_data_shards,
             )
+        # per-shard cohort-grouped update (data axis 1 only: the cohort
+        # network has no per-batch psum seam for intra-client DDP)
+        self._shard_cohort_update = (
+            build_cohort_local_update(
+                model,
+                self.task,
+                cfg.train,
+                self.batch_size,
+                self.arrays.max_client_samples,
+                self.cohort_per_shard,
+            )
+            if self.n_data_shards == 1
+            and cfg.train.cohort_fused
+            and cohort_update_supported(model, cfg.train)
+            else None
+        )
         self._round_fn = jax.jit(self._sharded_round, donate_argnums=(0,))
 
     def _prepare_data(self, data, cfg):
@@ -131,9 +152,18 @@ class ShardedFedAvg(FedAvgSim):
             ckeys = jax.vmap(
                 lambda c: R.client_key(rkey, shard * K + c)
             )(local)
-            stacked_vars, n_k, msums = jax.vmap(
-                self.local_update, in_axes=(None, 0, 0, None, None, 0)
-            )(state.variables, idx[local], mask[local], x, y, ckeys)
+            if self._shard_cohort_update is not None:
+                # cohort-grouped fast path per shard: this shard's slice
+                # of the cohort runs as ONE widened network (see
+                # fedml_tpu.models.cohort) — purely intra-shard compute,
+                # so it composes with the client-axis psum unchanged
+                stacked_vars, n_k, msums = self._shard_cohort_update(
+                    state.variables, idx[local], mask[local], x, y, ckeys
+                )
+            else:
+                stacked_vars, n_k, msums = jax.vmap(
+                    self.local_update, in_axes=(None, 0, 0, None, None, 0)
+                )(state.variables, idx[local], mask[local], x, y, ckeys)
 
             new_state = server_update(
                 cfg,

@@ -160,3 +160,72 @@ def test_sharded_matches_single_device_batchnorm_model():
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-5
         )
+
+
+def test_sharded_cohort_path_matches_single_device():
+    """With the cohort-grouped fast path active (BN-free conv net, sgd),
+    the sharded runtime (per-shard cohort nets of C/n_shards clients)
+    must match the single-device mirror (one cohort net of C clients).
+    Grouping does not change per-client math, but XLA compiles the two
+    group sizes differently (dense expansion reassociates reductions),
+    so equality is to f32 round-off, not bitwise."""
+    mesh = make_mesh(client_axis=4, data_axis=1)
+    cfg = cfg_for(
+        MeshConfig(client_axis_size=4, data_axis_size=1),
+        model=ModelConfig(
+            name="cnn_fedavg", num_classes=10, input_shape=(16, 16, 3)
+        ),
+        data=DataConfig(
+            dataset="fake_cifar10", num_clients=8, batch_size=16, seed=5,
+            partition_method="hetero", partition_alpha=0.5, dataset_r=0.05,
+        ),
+        fed=FedConfig(num_rounds=1, clients_per_round=8, eval_every=1),
+    )
+    data = load_dataset(cfg.data)
+    data.x_train = data.x_train[:, ::2, ::2, :]
+    data.x_test = data.x_test[:, ::2, ::2, :]
+    model = create_model(cfg.model)
+    single = FedAvgSim(model, data, cfg, sampler=stratified(4))
+    sharded = ShardedFedAvg(model, data, cfg, mesh)
+    assert single._cohort_update is not None
+    assert sharded._shard_cohort_update is not None
+    s1, _ = single.run_round(single.init())
+    s2, _ = sharded.run_round(sharded.init())
+    for a, b in zip(
+        jax.tree.leaves(s1.variables), jax.tree.leaves(s2.variables)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-4, rtol=1e-3
+        )
+
+
+def test_sharded_cohort_one_client_per_shard():
+    """cohort_per_shard == 1 (clients_per_round == n_shards): the
+    degenerate cohort must route through the per-client apply (stacked
+    dense kernels cannot feed the base head) and still match."""
+    mesh = make_mesh(client_axis=4, data_axis=1)
+    cfg = cfg_for(
+        MeshConfig(client_axis_size=4, data_axis_size=1),
+        model=ModelConfig(
+            name="cnn_fedavg", num_classes=10, input_shape=(16, 16, 3)
+        ),
+        data=DataConfig(
+            dataset="fake_cifar10", num_clients=8, batch_size=16, seed=6,
+        ),
+        fed=FedConfig(num_rounds=1, clients_per_round=4, eval_every=1),
+    )
+    data = load_dataset(cfg.data)
+    data.x_train = data.x_train[:, ::2, ::2, :]
+    data.x_test = data.x_test[:, ::2, ::2, :]
+    model = create_model(cfg.model)
+    single = FedAvgSim(model, data, cfg, sampler=stratified(4))
+    sharded = ShardedFedAvg(model, data, cfg, mesh)
+    assert sharded._shard_cohort_update is not None
+    s1, _ = single.run_round(single.init())
+    s2, _ = sharded.run_round(sharded.init())
+    for a, b in zip(
+        jax.tree.leaves(s1.variables), jax.tree.leaves(s2.variables)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-5
+        )
