@@ -71,6 +71,19 @@ class CohortDense(nn.Module):
         return y + bias.astype(y.dtype)
 
 
+def cohort_flatten(x: jax.Array, cohort: int) -> jax.Array:
+    """Per-client flatten of grouped activations ``[B, H, W, C*ch]`` (ch
+    blocks c-major) to ``[B, C, H*W*ch]`` in the base model's (H, W, ch)
+    flatten order — the bridge from a grouped conv trunk to
+    :class:`CohortDense`. The c-major channel-block convention here MUST
+    match :func:`stack_to_fat`'s kernel layout; keep it in one place."""
+    if cohort == 1:
+        return x.reshape((x.shape[0], -1))
+    b, h, w, cch = x.shape
+    x = x.reshape(b, h, w, cohort, cch // cohort)
+    return x.transpose(0, 3, 1, 2, 4).reshape(b, cohort, -1)
+
+
 def dense(features: int, cohort: int, name: str):
     """The head/dense factory zoo modules use in both modes, so the flax
     scope name (and thus the variables tree) is mode-independent."""

@@ -21,6 +21,7 @@ from typing import Sequence
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from fedml_tpu.ops.cohort_conv import Conv2D
 
 PRIMITIVES = (
     "none",
@@ -50,7 +51,7 @@ def _op(name: str, channels: int, stride: int):
             if stride == 1 and x.shape[-1] == channels:
                 return x
             # factorized reduce (reference FactorizedReduce)
-            h = nn.Conv(channels, (1, 1), strides=(stride, stride),
+            h = Conv2D(channels, (1, 1), strides=(stride, stride),
                         use_bias=False)(x)
             return nn.BatchNorm(use_running_average=not train)(h)
 
@@ -70,7 +71,7 @@ def _op(name: str, channels: int, stride: int):
                     x, -jnp.inf, jax.lax.max, window, strides, "SAME"
                 )
             if h.shape[-1] != channels:
-                h = nn.Conv(channels, (1, 1), use_bias=False)(h)
+                h = Conv2D(channels, (1, 1), use_bias=False)(h)
             return h
 
     class SepConv(nn.Module):
@@ -79,13 +80,13 @@ def _op(name: str, channels: int, stride: int):
         @nn.compact
         def __call__(self, x, train=False):
             h = nn.relu(x)
-            h = nn.Conv(
+            h = Conv2D(
                 x.shape[-1], (3, 3), strides=(stride, stride),
                 padding="SAME", feature_group_count=x.shape[-1],
                 kernel_dilation=(self.dilation, self.dilation),
                 use_bias=False,
             )(h)
-            h = nn.Conv(channels, (1, 1), use_bias=False)(h)
+            h = Conv2D(channels, (1, 1), use_bias=False)(h)
             return nn.BatchNorm(use_running_average=not train)(h)
 
     return {
@@ -130,8 +131,8 @@ class SearchCell(nn.Module):
         # operations.py)
         if s0.shape[1] != s1.shape[1]:
             s0 = s0[:, ::2, ::2, :]
-        s0 = nn.Conv(self.channels, (1, 1), use_bias=False)(s0)
-        s1 = nn.Conv(self.channels, (1, 1), use_bias=False)(s1)
+        s0 = Conv2D(self.channels, (1, 1), use_bias=False)(s0)
+        s1 = Conv2D(self.channels, (1, 1), use_bias=False)(s1)
         if self.reduction:
             s0 = s0[:, ::2, ::2, :]
             s1 = s1[:, ::2, ::2, :]
@@ -172,7 +173,7 @@ class DARTSNetwork(nn.Module):
         w_r = jax.nn.softmax(a_r, axis=-1)
 
         c = self.init_channels
-        h = nn.Conv(c, (3, 3), padding="SAME", use_bias=False)(x)
+        h = Conv2D(c, (3, 3), padding="SAME", use_bias=False)(x)
         h = nn.BatchNorm(use_running_average=not train)(h)
         s0 = s1 = h
         for layer in range(self.layers):

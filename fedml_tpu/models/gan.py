@@ -29,6 +29,7 @@ from typing import Any, Sequence
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from fedml_tpu.ops.cohort_conv import Conv2D, ConvTranspose2D
 
 
 def _plan_upsampling(img_size: int, min_init: int = 4) -> tuple[int, int]:
@@ -65,12 +66,12 @@ class _GeneratorPyramid(nn.Module):
         h = h.reshape((-1, init_size, init_size, first_filters))
         for i in range(n_blocks):
             feats = self.ngf * (2 ** (n_blocks - 1 - i))
-            h = nn.ConvTranspose(
+            h = ConvTranspose2D(
                 feats, (4, 4), strides=(2, 2), padding="SAME", use_bias=False
             )(h)
             h = nn.BatchNorm(use_running_average=not train)(h)
             h = nn.relu(h)
-        h = nn.ConvTranspose(
+        h = ConvTranspose2D(
             self.channels, (4, 4), strides=(2, 2), padding="SAME",
             use_bias=False,
         )(h)
@@ -134,7 +135,7 @@ class ACGANDiscriminator(nn.Module):
     def __call__(self, x, train: bool = False, discriminator: bool = False):
         h = x
         for f in self.features:
-            h = nn.Conv(f, (3, 3), strides=(2, 2), padding="SAME",
+            h = Conv2D(f, (3, 3), strides=(2, 2), padding="SAME",
                         use_bias=False)(h)
             h = nn.leaky_relu(h, 0.2)
             h = nn.Dropout(self.dropout, deterministic=not train)(h)

@@ -24,6 +24,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 import numpy as np
+from fedml_tpu.ops.cohort_conv import Conv2D
 
 
 
@@ -42,18 +43,18 @@ class Bottleneck(nn.Module):
         bn = lambda name: nn.BatchNorm(
             use_running_average=not train, name=name
         )
-        h = nn.Conv(self.planes, (1, 1), use_bias=False, name="conv1")(x)
+        h = Conv2D(self.planes, (1, 1), use_bias=False, name="conv1")(x)
         h = nn.relu(bn("bn1")(h))
-        h = nn.Conv(
+        h = Conv2D(
             self.planes, (3, 3), strides=(self.stride, self.stride),
             padding="SAME", use_bias=False, name="conv2",
         )(h)
         h = nn.relu(bn("bn2")(h))
-        h = nn.Conv(out_ch, (1, 1), use_bias=False, name="conv3")(h)
+        h = Conv2D(out_ch, (1, 1), use_bias=False, name="conv3")(h)
         h = bn("bn3")(h)
         identity = x
         if self.stride != 1 or x.shape[-1] != out_ch:
-            identity = nn.Conv(
+            identity = Conv2D(
                 out_ch, (1, 1), strides=(self.stride, self.stride),
                 use_bias=False, name="downsample_conv",
             )(x)
@@ -73,7 +74,7 @@ class GKTClientResNet(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        h = nn.Conv(
+        h = Conv2D(
             self.width, (3, 3), padding="SAME", use_bias=False, name="conv1"
         )(x)
         h = nn.BatchNorm(use_running_average=not train, name="bn1")(h)
@@ -188,7 +189,7 @@ class SplitClientNet(nn.Module):
     def __call__(self, x, train: bool = False):
         h = x
         for f in self.features:
-            h = nn.Conv(f, (3, 3), strides=(2, 2), padding="SAME")(h)
+            h = Conv2D(f, (3, 3), strides=(2, 2), padding="SAME")(h)
             h = nn.relu(h)
         return h
 

@@ -17,6 +17,7 @@ from typing import Sequence
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from fedml_tpu.ops.cohort_conv import Conv2D
 
 
 class DeepLabLite(nn.Module):
@@ -29,30 +30,30 @@ class DeepLabLite(nn.Module):
     def __call__(self, x, train: bool = False):
         h = x
         for f in self.encoder_features:
-            h = nn.Conv(f, (3, 3), strides=(2, 2), padding="SAME",
+            h = Conv2D(f, (3, 3), strides=(2, 2), padding="SAME",
                         use_bias=False)(h)
             h = nn.BatchNorm(use_running_average=not train)(h)
             h = nn.relu(h)
         # ASPP: parallel dilated branches + global context
         branches = []
         for r in self.aspp_rates:
-            b = nn.Conv(
+            b = Conv2D(
                 self.aspp_features, (3, 3), padding="SAME",
                 kernel_dilation=(r, r), use_bias=False,
             )(h)
             b = nn.BatchNorm(use_running_average=not train)(b)
             branches.append(nn.relu(b))
         gp = jnp.mean(h, axis=(1, 2), keepdims=True)
-        gp = nn.Conv(self.aspp_features, (1, 1), use_bias=False)(gp)
+        gp = Conv2D(self.aspp_features, (1, 1), use_bias=False)(gp)
         gp = jnp.broadcast_to(
             gp, (h.shape[0],) + h.shape[1:3] + (self.aspp_features,)
         )
         branches.append(gp)
         h = jnp.concatenate(branches, axis=-1)
-        h = nn.Conv(self.aspp_features, (1, 1), use_bias=False)(h)
+        h = Conv2D(self.aspp_features, (1, 1), use_bias=False)(h)
         h = nn.BatchNorm(use_running_average=not train)(h)
         h = nn.relu(h)
-        logits = nn.Conv(self.num_classes, (1, 1))(h)
+        logits = Conv2D(self.num_classes, (1, 1))(h)
         # bilinear upsample back to input resolution
         return jax.image.resize(
             logits,

@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from fedml_tpu.models.cohort import dense as _cohort_dense
+from fedml_tpu.models.cohort import cohort_flatten, dense as _cohort_dense
 from fedml_tpu.ops.cohort_conv import Conv2D
 
 
@@ -48,13 +48,7 @@ class CNNOriginalFedAvg(nn.Module):
         x = Conv2D(64 * co, (5, 5), padding="SAME",
                    feature_group_count=co)(x)
         x = nn.max_pool(nn.relu(x), (2, 2), (2, 2))
-        if co > 1:
-            # per-client flatten in base (H, W, ch) order
-            b, h, w, cch = x.shape
-            x = x.reshape(b, h, w, co, cch // co)
-            x = x.transpose(0, 3, 1, 2, 4).reshape(b, co, -1)
-        else:
-            x = x.reshape((x.shape[0], -1))
+        x = cohort_flatten(x, co)
         x = nn.relu(_cohort_dense(512, co, "fc1")(x))
         y = _cohort_dense(self.num_classes, co, "head")(x)
         return y.transpose(1, 0, 2) if co > 1 else y
@@ -86,18 +80,24 @@ class CNNParameterised(nn.Module):
     conv_channels: Sequence[int] = (32, 64)
     dense_sizes: Sequence[int] = (128,)
     dropout: float = 0.0
+    cohort: int = 1
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        co = self.cohort
         for ch in self.conv_channels:
-            x = nn.relu(Conv2D(ch, (3, 3), padding="SAME")(x))
+            x = nn.relu(
+                Conv2D(ch * co, (3, 3), padding="SAME",
+                       feature_group_count=co)(x)
+            )
             x = nn.max_pool(x, (2, 2), (2, 2))
-        x = x.reshape((x.shape[0], -1))
-        for d in self.dense_sizes:
-            x = nn.relu(nn.Dense(d)(x))
+        x = cohort_flatten(x, co)
+        for i, d in enumerate(self.dense_sizes):
+            x = nn.relu(_cohort_dense(d, co, f"fc{i + 1}")(x))
             if self.dropout > 0:
                 x = nn.Dropout(self.dropout, deterministic=not train)(x)
-        return nn.Dense(self.num_classes)(x)
+        y = _cohort_dense(self.num_classes, co, "head")(x)
+        return y.transpose(1, 0, 2) if co > 1 else y
 
 
 def _norm(kind: str, train: bool, cohort: int = 1):

@@ -18,6 +18,7 @@ from typing import Sequence
 
 import flax.linen as nn
 import jax.numpy as jnp
+from fedml_tpu.ops.cohort_conv import Conv2D
 
 
 def hswish(x):
@@ -58,10 +59,10 @@ class MBConv(nn.Module):
         h = x
         mid = cin * self.expand
         if self.expand != 1:
-            h = nn.Conv(mid, (1, 1), use_bias=False)(h)
+            h = Conv2D(mid, (1, 1), use_bias=False)(h)
             h = nn.BatchNorm(use_running_average=not train)(h)
             h = act(h)
-        h = nn.Conv(
+        h = Conv2D(
             mid, (self.kernel, self.kernel),
             strides=(self.stride, self.stride), padding="SAME",
             feature_group_count=mid, use_bias=False,
@@ -70,7 +71,7 @@ class MBConv(nn.Module):
         h = act(h)
         if self.use_se:
             h = SqueezeExcite()(h)
-        h = nn.Conv(self.out_channels, (1, 1), use_bias=False)(h)
+        h = Conv2D(self.out_channels, (1, 1), use_bias=False)(h)
         h = nn.BatchNorm(use_running_average=not train)(h)
         if self.stride == 1 and cin == self.out_channels:
             h = h + x
@@ -99,13 +100,13 @@ class MobileNetV3(nn.Module):
         def c(ch):
             return max(8, int(ch * self.width_mult))
 
-        h = nn.Conv(c(16), (3, 3), strides=(2, 2), padding="SAME",
+        h = Conv2D(c(16), (3, 3), strides=(2, 2), padding="SAME",
                     use_bias=False)(x)
         h = nn.BatchNorm(use_running_average=not train)(h)
         h = hswish(h)
         for out, exp, k, s, se, act in self.blocks:
             h = MBConv(c(out), exp, k, s, se, act)(h, train=train)
-        h = nn.Conv(c(288), (1, 1), use_bias=False)(h)
+        h = Conv2D(c(288), (1, 1), use_bias=False)(h)
         h = nn.BatchNorm(use_running_average=not train)(h)
         h = hswish(h)
         h = jnp.mean(h, axis=(1, 2))
@@ -144,7 +145,7 @@ class EfficientNet(nn.Module):
         def depth(r):
             return int(math.ceil(r * self.depth_coef))
 
-        h = nn.Conv(width(32), (3, 3), strides=(2, 2), padding="SAME",
+        h = Conv2D(width(32), (3, 3), strides=(2, 2), padding="SAME",
                     use_bias=False)(x)
         h = nn.BatchNorm(use_running_average=not train)(h)
         h = nn.swish(h)
@@ -153,7 +154,7 @@ class EfficientNet(nn.Module):
                 h = MBConv(
                     width(out), exp, k, s if r == 0 else 1, True, "swish"
                 )(h, train=train)
-        h = nn.Conv(width(1280), (1, 1), use_bias=False)(h)
+        h = Conv2D(width(1280), (1, 1), use_bias=False)(h)
         h = nn.BatchNorm(use_running_average=not train)(h)
         h = nn.swish(h)
         h = jnp.mean(h, axis=(1, 2))
@@ -168,10 +169,10 @@ class LeNet(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        h = nn.Conv(20, (5, 5))(x)
+        h = Conv2D(20, (5, 5))(x)
         h = nn.max_pool(h, (2, 2), strides=(2, 2))
         h = nn.relu(h)
-        h = nn.Conv(50, (5, 5))(h)
+        h = Conv2D(50, (5, 5))(h)
         h = nn.max_pool(h, (2, 2), strides=(2, 2))
         h = nn.relu(h)
         h = h.reshape((h.shape[0], -1))

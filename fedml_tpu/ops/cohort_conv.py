@@ -57,19 +57,25 @@ DN = ("NHWC", "HWIO", "NHWC")
 
 
 def _resolve_padding(
-    padding, in_spatial, kernel_spatial, strides, rhs_dilation
+    padding, in_spatial, kernel_spatial, strides, rhs_dilation,
+    lhs_dilation=(1, 1),
 ) -> tuple[tuple[int, int], ...]:
     """Resolve "SAME"/"VALID"/explicit padding to explicit (lo, hi) pairs
-    (primitive params must not depend on operand shapes at rule time)."""
+    (primitive params must not depend on operand shapes at rule time).
+    With input (lhs) dilation, SAME is resolved against the dilated
+    extent — transposed convs compute their own explicit pairs instead
+    (see :func:`_conv_transpose_pads`)."""
     if isinstance(padding, str):
         pad = padding.upper()
         if pad == "VALID":
             return tuple((0, 0) for _ in in_spatial)
         if pad == "SAME":
             out = []
-            for i, k, s, d in zip(
-                in_spatial, kernel_spatial, strides, rhs_dilation
+            for i, k, s, d, ld in zip(
+                in_spatial, kernel_spatial, strides, rhs_dilation,
+                lhs_dilation,
             ):
+                i = (i - 1) * ld + 1
                 eff_k = (k - 1) * d + 1
                 o = -(-i // s)  # ceil
                 total = max((o - 1) * s + eff_k - i, 0)
@@ -79,7 +85,23 @@ def _resolve_padding(
     return tuple((int(lo), int(hi)) for lo, hi in padding)
 
 
-def _out_spatial(i, pad, k, s, d):
+def _conv_transpose_pads(k, s, padding):
+    """Explicit (lo, hi) padding of the fractionally-strided conv that
+    realizes a transposed conv — same rule as ``lax.conv_transpose``
+    (SAME: out = in*s; VALID: out = in*s + max(k-s, 0))."""
+    if padding == "SAME":
+        pad_len = k + s - 2
+        pad_a = k - 1 if s > k - 1 else -(-pad_len // 2)
+    elif padding == "VALID":
+        pad_len = k + s - 2 + max(k - s, 0)
+        pad_a = k - 1
+    else:
+        raise ValueError(f"unknown transpose padding {padding!r}")
+    return (pad_a, pad_len - pad_a)
+
+
+def _out_spatial(i, pad, k, s, d, ld=1):
+    i = (i - 1) * ld + 1
     eff_k = (k - 1) * d + 1
     return (i + pad[0] + pad[1] - eff_k) // s + 1
 
@@ -89,12 +111,14 @@ def _out_spatial(i, pad, k, s, d):
 # ---------------------------------------------------------------------------
 
 
-def _lax_fwd(x, w, *, strides, padding, fgc, rhs_dilation, **_):
+def _lax_fwd(x, w, *, strides, padding, fgc, rhs_dilation,
+             lhs_dilation=(1, 1), **_):
     return lax.conv_general_dilated(
         x,
         w,
         window_strides=strides,
         padding=padding,
+        lhs_dilation=lhs_dilation,
         rhs_dilation=rhs_dilation,
         dimension_numbers=DN,
         feature_group_count=fgc,
@@ -118,7 +142,8 @@ def _lax_dw(x, dy, *, rhs_shape, **params):
 # ---------------------------------------------------------------------------
 
 
-def _cohort_fwd(x_b, w_b, *, strides, padding, fgc, rhs_dilation, **_):
+def _cohort_fwd(x_b, w_b, *, strides, padding, fgc, rhs_dilation,
+                lhs_dilation=(1, 1), **_):
     """Batched-over-(x, w) conv as ONE grouped conv: clients become channel
     groups. Bit-identical to ``vmap(conv)`` — group c of the grouped conv
     sees exactly client c's channels and kernel."""
@@ -131,6 +156,7 @@ def _cohort_fwd(x_b, w_b, *, strides, padding, fgc, rhs_dilation, **_):
         wg,
         window_strides=strides,
         padding=padding,
+        lhs_dilation=lhs_dilation,
         rhs_dilation=rhs_dilation,
         dimension_numbers=DN,
         feature_group_count=C * fgc,
@@ -204,11 +230,13 @@ def _make(name, impl, batch_rule, abstract):
     return p
 
 
-def _fwd_abstract(x, w, *, strides, padding, rhs_dilation, rhs_shape, **_):
+def _fwd_abstract(x, w, *, strides, padding, rhs_dilation, rhs_shape,
+                  lhs_dilation=(1, 1), **_):
     spatial = tuple(
-        _out_spatial(i, p, k, s, d)
-        for i, p, k, s, d in zip(
-            x.shape[1:3], padding, rhs_shape[:2], strides, rhs_dilation
+        _out_spatial(i, p, k, s, d, ld)
+        for i, p, k, s, d, ld in zip(
+            x.shape[1:3], padding, rhs_shape[:2], strides, rhs_dilation,
+            lhs_dilation,
         )
     )
     return ShapedArray(
@@ -255,16 +283,21 @@ def cohort_conv(
     padding: Any = "SAME",
     feature_group_count: int = 1,
     rhs_dilation: Sequence[int] = (1, 1),
+    lhs_dilation: Sequence[int] = (1, 1),
 ) -> jax.Array:
     """2-D convolution (NHWC x HWIO -> NHWC) with cohort-aware batching.
 
     Semantically identical to ``lax.conv_general_dilated``; under ``vmap``
     over both operands it lowers to a single grouped convolution.
+    ``lhs_dilation`` gives the fractionally-strided form used by
+    transposed convolutions (:class:`ConvTranspose2D`).
     """
     strides = tuple(int(s) for s in strides)
     rhs_dilation = tuple(int(d) for d in rhs_dilation)
+    lhs_dilation = tuple(int(d) for d in lhs_dilation)
     pad = _resolve_padding(
-        padding, x.shape[1:3], kernel.shape[:2], strides, rhs_dilation
+        padding, x.shape[1:3], kernel.shape[:2], strides, rhs_dilation,
+        lhs_dilation,
     )
     if x.dtype != kernel.dtype:
         ct = jnp.promote_types(x.dtype, kernel.dtype)
@@ -276,6 +309,7 @@ def cohort_conv(
         padding=pad,
         fgc=int(feature_group_count),
         rhs_dilation=rhs_dilation,
+        lhs_dilation=lhs_dilation,
         lhs_shape=tuple(x.shape),
         rhs_shape=tuple(kernel.shape),
     )
@@ -301,6 +335,8 @@ class Conv2D(nn.Module):
     use_bias: bool = True
     feature_group_count: int = 1
     rhs_dilation: Sequence[int] = (1, 1)
+    # alias matching nn.Conv's keyword (overrides rhs_dilation when set)
+    kernel_dilation: Any = None
     kernel_init: Any = nn.initializers.lecun_normal()
     bias_init: Any = nn.initializers.zeros_init()
 
@@ -325,7 +361,51 @@ class Conv2D(nn.Module):
             strides=self.strides,
             padding=self.padding,
             feature_group_count=self.feature_group_count,
-            rhs_dilation=self.rhs_dilation,
+            rhs_dilation=self.kernel_dilation or self.rhs_dilation,
+        )
+        if self.use_bias:
+            bias = self.param("bias", self.bias_init, (self.features,))
+            y = y + bias.astype(y.dtype)
+        return y
+
+
+class ConvTranspose2D(nn.Module):
+    """Drop-in for the zoo's uses of ``nn.ConvTranspose`` (2-D, NHWC),
+    backed by :func:`cohort_conv` in fractionally-strided form
+    (``lhs_dilation = strides``, explicit transpose padding, unit window
+    strides — the same realization ``lax.conv_transpose`` uses, kernel
+    unflipped). Parameter names, shapes, and initializers match
+    ``nn.ConvTranspose``, so generators vmapped over per-client params
+    get the grouped cohort lowering for free."""
+
+    features: int
+    kernel_size: Sequence[int]
+    strides: Sequence[int] = (1, 1)
+    padding: str = "SAME"
+    use_bias: bool = True
+    kernel_init: Any = nn.initializers.lecun_normal()
+    bias_init: Any = nn.initializers.zeros_init()
+
+    @nn.compact
+    def __call__(self, x):
+        kh, kw = self.kernel_size
+        cin = x.shape[-1]
+        kernel = self.param(
+            "kernel", self.kernel_init, (kh, kw, cin, self.features)
+        )
+        if x.dtype != kernel.dtype:
+            kernel = kernel.astype(jnp.promote_types(x.dtype, kernel.dtype))
+            x = x.astype(kernel.dtype)
+        pads = tuple(
+            _conv_transpose_pads(k, s, self.padding)
+            for k, s in zip((kh, kw), self.strides)
+        )
+        y = cohort_conv(
+            x,
+            kernel,
+            strides=(1, 1),
+            padding=pads,
+            lhs_dilation=self.strides,
         )
         if self.use_bias:
             bias = self.param("bias", self.bias_init, (self.features,))

@@ -23,7 +23,7 @@ fetch() { # fetch <url> <out-file>
   if command -v curl >/dev/null; then
     curl -fL --retry 3 -o "$2" "$1"
   else
-    wget --no-check-certificate -O "$2" "$1"
+    wget -O "$2" "$1"
   fi
 }
 
@@ -31,10 +31,19 @@ gdrive() { # gdrive <file-id> <out-file>  (large-file confirm dance)
   local id="$1" out="$2"
   mkdir -p "$(dirname "$out")"
   local base="https://docs.google.com/uc?export=download"
-  local confirm
-  confirm=$(curl -sc /tmp/gcookie "${base}&id=${id}" \
-    | sed -rn 's/.*confirm=([0-9A-Za-z_]+).*/\1/p' || true)
-  curl -fLb /tmp/gcookie -o "$out" "${base}&confirm=${confirm}&id=${id}"
+  local jar confirm
+  jar=$(mktemp)
+  if command -v curl >/dev/null; then
+    confirm=$(curl -sc "$jar" "${base}&id=${id}" \
+      | sed -rn 's/.*confirm=([0-9A-Za-z_]+).*/\1/p' || true)
+    curl -fLb "$jar" -o "$out" "${base}&confirm=${confirm}&id=${id}"
+  else
+    confirm=$(wget -q --save-cookies "$jar" --keep-session-cookies \
+      "${base}&id=${id}" -O- \
+      | sed -rn 's/.*confirm=([0-9A-Za-z_]+).*/\1/p' || true)
+    wget --load-cookies "$jar" -O "$out" "${base}&confirm=${confirm}&id=${id}"
+  fi
+  rm -f "$jar"
 }
 
 untar() { mkdir -p "$2" && tar -xf "$1" -C "$2"; }

@@ -145,7 +145,8 @@ def test_second_order_and_nested_vmap():
 
 
 @pytest.mark.parametrize(
-    "name", ["resnet8", "resnet8_gn", "resnet8_s2d", "cnn_fedavg"]
+    "name", ["resnet8", "resnet8_gn", "resnet8_s2d", "cnn_fedavg",
+             "cnn_small"]
 )
 def test_apply_cohort_equals_vmap(name):
     model = create_model(
