@@ -38,6 +38,12 @@ def parse_args(argv=None) -> tuple[ExperimentConfig, int]:
     p.add_argument("--batch_size", type=int, default=None)
     p.add_argument("--lr", type=float, default=None)
     p.add_argument("--client_optimizer", type=str, default=None)
+    p.add_argument("--compute_dtype", type=str, default=None,
+                   choices=["float32", "bfloat16"],
+                   help="mixed-precision compute dtype (params stay f32)")
+    p.add_argument("--no_cohort_fused", action="store_true",
+                   help="disable the cohort-grouped fast path (always "
+                        "vmap the per-client local update)")
     p.add_argument("--partition_method", type=str, default=None)
     p.add_argument("--partition_alpha", type=float, default=None)
     p.add_argument("--frequency_of_the_test", type=int, default=None)
@@ -84,6 +90,8 @@ def parse_args(argv=None) -> tuple[ExperimentConfig, int]:
         train=rep(
             cfg.train, lr=a.lr, epochs=a.epochs,
             optimizer=a.client_optimizer,
+            compute_dtype=a.compute_dtype,
+            cohort_fused=False if a.no_cohort_fused else None,
         ),
         fed=rep(
             cfg.fed,

@@ -334,9 +334,8 @@ class Conv2D(nn.Module):
     padding: Any = "SAME"
     use_bias: bool = True
     feature_group_count: int = 1
-    rhs_dilation: Sequence[int] = (1, 1)
-    # alias matching nn.Conv's keyword (overrides rhs_dilation when set)
-    kernel_dilation: Any = None
+    # same keyword as nn.Conv; an int applies to both spatial dims
+    kernel_dilation: Any = (1, 1)
     kernel_init: Any = nn.initializers.lecun_normal()
     bias_init: Any = nn.initializers.zeros_init()
 
@@ -355,13 +354,16 @@ class Conv2D(nn.Module):
             # for direct eval calls)
             kernel = kernel.astype(jnp.promote_types(x.dtype, kernel.dtype))
             x = x.astype(kernel.dtype)
+        kd = self.kernel_dilation
+        if isinstance(kd, int):
+            kd = (kd, kd)
         y = cohort_conv(
             x,
             kernel,
             strides=self.strides,
             padding=self.padding,
             feature_group_count=self.feature_group_count,
-            rhs_dilation=self.kernel_dilation or self.rhs_dilation,
+            rhs_dilation=kd,
         )
         if self.use_bias:
             bias = self.param("bias", self.bias_init, (self.features,))

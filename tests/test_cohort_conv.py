@@ -32,9 +32,9 @@ from fedml_tpu.ops.cohort_conv import cohort_conv
 from fedml_tpu.models import create_model
 
 
-def _lax_ref(x, w, s=(1, 1), p="SAME", d=(1, 1), g=1):
+def _lax_ref(x, w, s=(1, 1), p="SAME", d=(1, 1), g=1, ld=(1, 1)):
     return jax.lax.conv_general_dilated(
-        x, w, s, p, rhs_dilation=d,
+        x, w, s, p, rhs_dilation=d, lhs_dilation=ld,
         dimension_numbers=("NHWC", "HWIO", "NHWC"), feature_group_count=g,
     )
 
@@ -71,6 +71,9 @@ def test_fwd_matches_lax_all_batch_combos():
         {"padding": "VALID"},
         {"strides": (2, 2), "padding": "VALID"},
         {"rhs_dilation": (2, 2)},
+        # string padding is disallowed with lhs dilation at the lax
+        # level, so the fractionally-strided case pins explicit pads
+        {"lhs_dilation": (2, 2), "padding": ((1, 1), (1, 1))},
     ],
 )
 def test_vmap_grad_matches_lax(kwargs):
@@ -80,12 +83,13 @@ def test_vmap_grad_matches_lax(kwargs):
     s = kwargs.get("strides", (1, 1))
     p = kwargs.get("padding", "SAME")
     d = kwargs.get("rhs_dilation", (1, 1))
+    ld = kwargs.get("lhs_dilation", (1, 1))
 
     def loss_c(xi, wi):
         return (cohort_conv(xi, wi, **kwargs).astype(jnp.float32) ** 2).sum()
 
     def loss_r(xi, wi):
-        return (_lax_ref(xi, wi, s, p, d).astype(jnp.float32) ** 2).sum()
+        return (_lax_ref(xi, wi, s, p, d, ld=ld).astype(jnp.float32) ** 2).sum()
 
     gc = jax.jit(jax.vmap(jax.grad(loss_c, argnums=(0, 1))))(x, w)
     gr = jax.jit(jax.vmap(jax.grad(loss_r, argnums=(0, 1))))(x, w)
@@ -292,3 +296,41 @@ def test_dynamic_trip_count_skips_padding_exactly():
     np.testing.assert_array_equal(np.asarray(oc[1]), np.asarray(ov[1]))
     for a, b in zip(jax.tree.leaves(oc), jax.tree.leaves(ov)):
         np.testing.assert_allclose(a, b, atol=1e-5)
+
+
+@pytest.mark.parametrize(
+    "strides,ksz,pad",
+    [((2, 2), (4, 4), "SAME"), ((2, 2), (3, 3), "SAME"),
+     ((1, 1), (3, 3), "SAME"), ((2, 2), (4, 4), "VALID"),
+     ((3, 3), (2, 2), "SAME")],
+)
+def test_conv_transpose_2d_matches_flax(strides, ksz, pad):
+    """ConvTranspose2D (lhs-dilated cohort_conv) vs nn.ConvTranspose:
+    same init tree, same outputs, same vmapped-over-params gradients —
+    the GAN generators route all upsampling through this."""
+    import flax.linen as nn
+    from fedml_tpu.ops.cohort_conv import ConvTranspose2D
+
+    m1 = nn.ConvTranspose(7, ksz, strides=strides, padding=pad)
+    m2 = ConvTranspose2D(7, ksz, strides=strides, padding=pad)
+    x = jax.random.normal(jax.random.key(1), (2, 8, 8, 5))
+    v1 = m1.init(jax.random.key(0), x)
+    v2 = m2.init(jax.random.key(0), x)
+    for a, b in zip(jax.tree.leaves(v1), jax.tree.leaves(v2)):
+        assert a.shape == b.shape
+        np.testing.assert_array_equal(a, b)
+    np.testing.assert_allclose(m1.apply(v1, x), m2.apply(v2, x), atol=2e-6)
+
+    C = 3
+    xb = jax.random.normal(jax.random.key(2), (C, 2, 8, 8, 5))
+    vs = jax.vmap(lambda k: m1.init(k, xb[0]))(
+        jax.random.split(jax.random.key(3), C)
+    )
+    g1 = jax.vmap(jax.grad(lambda v, xi: (m1.apply(v, xi) ** 2).sum()))(
+        vs, xb
+    )
+    g2 = jax.vmap(jax.grad(lambda v, xi: (m2.apply(v, xi) ** 2).sum()))(
+        vs, xb
+    )
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+        np.testing.assert_allclose(a, b, atol=1e-4)
