@@ -52,7 +52,7 @@ PEAKS = {
 }
 
 
-def build_sim(num_clients=100, full_cifar=False):
+def build_sim(num_clients=100, full_cifar=False, model_name="resnet56"):
     from fedml_tpu.config import (
         DataConfig,
         ExperimentConfig,
@@ -74,7 +74,7 @@ def build_sim(num_clients=100, full_cifar=False):
             seed=0,
         ),
         model=ModelConfig(
-            name="resnet56", num_classes=10, input_shape=(32, 32, 3)
+            name=model_name, num_classes=10, input_shape=(32, 32, 3)
         ),
         # bf16 compute; the headline takes the cohort-fused path
         # (fedml_tpu.models.cohort) whose step loop has a dynamic trip
@@ -233,18 +233,28 @@ def main():
     ap.add_argument("--rounds", type=int, default=15)
     ap.add_argument("--skip-torch-baseline", action="store_true")
     ap.add_argument("--northstar", action="store_true")
+    ap.add_argument(
+        "--s2d",
+        action="store_true",
+        help="bench the resnet56_s2d space-to-depth parameterization "
+        "(same FLOP class/depth, TPU-friendly widths; separate metric "
+        "name — not comparable to reference checkpoints)",
+    )
     ap.add_argument("--target-acc", type=float, default=None)
     ap.add_argument("--max-rounds", type=int, default=2000)
     args = ap.parse_args()
 
     import jax
 
+    model_name = "resnet56_s2d" if args.s2d else "resnet56"
     if args.northstar:
-        sim, data = build_sim(num_clients=1000, full_cifar=True)
-        metric = "fedavg_rounds_per_sec_1000c_noniid_cifar10_resnet56"
+        sim, data = build_sim(
+            num_clients=1000, full_cifar=True, model_name=model_name
+        )
+        metric = f"fedavg_rounds_per_sec_1000c_noniid_cifar10_{model_name}"
     else:
-        sim, data = build_sim()
-        metric = "fedavg_rounds_per_sec_100c_cifar10_resnet56"
+        sim, data = build_sim(model_name=model_name)
+        metric = f"fedavg_rounds_per_sec_100c_cifar10_{model_name}"
 
     state = sim.init()
     # AOT-compile the round ONCE; the same executable serves warmup and
@@ -273,7 +283,7 @@ def main():
         print(
             json.dumps(
                 {
-                    "metric": f"time_to_{args.target_acc}_acc",
+                    "metric": f"time_to_{args.target_acc}_acc_{model_name}",
                     "value": round(reached, 2) if reached else None,
                     "unit": "seconds",
                     "vs_baseline": None,
@@ -300,6 +310,11 @@ def main():
     hbm = bbytes * rps / peak_bw if bbytes and peak_bw else None
 
     vs = float("nan")
+    if args.s2d:
+        # the torch baseline times the standard ResNet-56; comparing the
+        # s2d parameterization against it would be apples-to-oranges, so
+        # the s2d metric reports vs_baseline = null by construction
+        args.skip_torch_baseline = True
     if not args.skip_torch_baseline:
         # the reference serial loop runs ceil(n_k/B) real batches per
         # sampled client — use the mean over clients, NOT the padded max

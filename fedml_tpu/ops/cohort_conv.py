@@ -355,7 +355,9 @@ class Conv2D(nn.Module):
             kernel = kernel.astype(jnp.promote_types(x.dtype, kernel.dtype))
             x = x.astype(kernel.dtype)
         kd = self.kernel_dilation
-        if isinstance(kd, int):
+        if kd is None:  # nn.Conv also treats None as no dilation
+            kd = (1, 1)
+        elif isinstance(kd, int):
             kd = (kd, kd)
         y = cohort_conv(
             x,
