@@ -41,6 +41,17 @@ python -m fedml_tpu.experiments.run \
   --robust_method median --robust_norm_clip 1.0 \
   --robust_noise_stddev 0.001 \
   --out_dir "$OUT/smoke" --run_name smoke_robust > "$OUT/smoke_robust.json"
+echo "  -- vfl (two-party vertical, procedural)"
+python -m fedml_tpu.experiments.run \
+  --algorithm vfl --dataset fake_vfl --comm_round 4 --lr 0.1 \
+  --batch_size 32 --frequency_of_the_test 4 \
+  --out_dir "$OUT/smoke" --run_name smoke_vfl > "$OUT/smoke_vfl.json"
+echo "  -- turboaggregate (secure aggregation)"
+python -m fedml_tpu.experiments.run \
+  --algorithm turboaggregate --dataset fake_mnist --model lr \
+  --client_num_in_total 8 --client_num_per_round 4 --comm_round 2 \
+  --num_classes 10 --input_shape 28 28 1 --frequency_of_the_test 2 \
+  --out_dir "$OUT/smoke" --run_name smoke_ta > "$OUT/smoke_ta.json"
 echo "  -- decentralized dol_dsgd (regret)"
 python -m fedml_tpu.experiments.run \
   --algorithm dol_dsgd --dataset fake_susy --client_num_in_total 4 \

@@ -248,7 +248,13 @@ class FedAvgSim:
         )
 
     # -- one round ---------------------------------------------------------
-    def _round(self, state: ServerState, arrays: FederatedArrays):
+    def _locals(self, state: ServerState, arrays: FederatedArrays):
+        """Sampling + local updates, the pre-aggregation prefix of the
+        round: returns (stacked_vars, n_k, metric sums, round key). Shared
+        with aggregation rules that live outside the compiled round (e.g.
+        TurboAggregate secure aggregation,
+        :class:`fedml_tpu.algorithms.mpc.SecureFedAvgSim`) so alternate
+        servers cannot drift from the canonical sampling/local math."""
         cfg = self.cfg.fed
         rkey = R.round_key(self.root_key, state.round)
         cohort = self.sampler(
@@ -269,6 +275,11 @@ class FedAvgSim:
             stacked_vars, n_k, msums = jax.vmap(
                 self.local_update, in_axes=(None, 0, 0, None, None, 0)
             )(state.variables, idx_rows, mask_rows, arrays.x, arrays.y, ckeys)
+        return stacked_vars, n_k, msums, rkey
+
+    def _round(self, state: ServerState, arrays: FederatedArrays):
+        cfg = self.cfg.fed
+        stacked_vars, n_k, msums, rkey = self._locals(state, arrays)
 
         new_state = server_update(
             cfg,

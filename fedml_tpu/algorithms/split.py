@@ -661,6 +661,13 @@ class VFLSim:
             loss,
         )
 
+    def run_round(self, state: VFLState):
+        """Harness protocol adapter: one VFL "round" = one epoch over the
+        aligned feature-partitioned batches (the reference's epoch loop,
+        ``classical_vertical_fl/vfl_fixture.py``)."""
+        state, loss = self.run_epoch(state)
+        return state, {"train_loss": loss}
+
     def run_epoch(self, state: VFLState) -> tuple[VFLState, float]:
         n = self.x_train.shape[0]
         bs = self.batch_size

@@ -138,6 +138,66 @@ def _build_splitnn(cfg: ExperimentConfig):
     )
 
 
+def _build_vfl(cfg: ExperimentConfig):
+    """Two-party classical vertical FL (reference
+    ``standalone/classical_vertical_fl/vfl_fixture.py``): guest holds the
+    labels, both parties contribute logit components from their feature
+    slice. Datasets: ``nus_wide`` / ``lending_club`` real files under
+    ``data_dir``, else ``fake_vfl`` — a seeded linearly-separable
+    two-party set so offline smoke runs converge."""
+    import numpy as np
+
+    from fedml_tpu.algorithms.split import VFLSim
+    from fedml_tpu.models.gkt import VFLDenseModel, VFLLocalModel
+
+    ds = cfg.data.dataset
+    if ds == "nus_wide":
+        from fedml_tpu.data.vertical import load_nus_wide_two_party
+
+        # VFLSim is a binary sigmoid-BCE model (reference vfl.py), so the
+        # multi-concept labels must be binarized; "person"-vs-rest is the
+        # reference experiments' usual positive concept
+        d = load_nus_wide_two_party(
+            cfg.data.data_dir, binary_positive="person"
+        )
+    elif ds == "lending_club":
+        from fedml_tpu.data.vertical import load_lending_club_two_party
+
+        d = load_lending_club_two_party(cfg.data.data_dir)
+    else:  # fake_vfl / any offline name
+        rng = np.random.default_rng(cfg.data.seed)
+        n, dim = 512, 24
+        w = rng.normal(size=(dim,))
+        x = rng.normal(size=(n, dim)).astype(np.float32)
+        xt = rng.normal(size=(n // 4, dim)).astype(np.float32)
+        d = {
+            "train": (x, (x @ w > 0).astype(np.float32)),
+            "test": (xt, (xt @ w > 0).astype(np.float32)),
+            "splits": [(0, dim // 2), (dim // 2, dim)],
+        }
+    return VFLSim(
+        party_models=[
+            (VFLLocalModel(out_dim=8, hidden=16), VFLDenseModel())
+            for _ in d["splits"]
+        ],
+        feature_splits=d["splits"],
+        x_train=d["train"][0],
+        y_train=d["train"][1],
+        x_test=d["test"][0],
+        y_test=d["test"][1],
+        cfg=cfg,
+    )
+
+
+def _build_turboaggregate(cfg: ExperimentConfig):
+    """FedAvg with TurboAggregate secure aggregation as the server rule
+    (reference ``distributed/turboaggregate``)."""
+    from fedml_tpu.algorithms.mpc import SecureFedAvgSim
+
+    data = load_dataset(cfg.data)
+    return SecureFedAvgSim(create_model(cfg.model), data, cfg)
+
+
 def _build_fednas(cfg: ExperimentConfig):
     from fedml_tpu.algorithms.fednas import FedNASSim
     from fedml_tpu.models.darts import DARTSNetwork
@@ -226,6 +286,9 @@ ALGORITHMS: dict[str, Callable[[ExperimentConfig], Any]] = {
     "fedarjun": _build_distill("fedarjun"),
     "fedgkt": _build_fedgkt,
     "splitnn": _build_splitnn,
+    "vfl": _build_vfl,
+    "classical_vertical_fl": _build_vfl,
+    "turboaggregate": _build_turboaggregate,
     "fednas": _build_fednas,
     "baseline": _build_baseline,
     "centralized": _build_centralized,
