@@ -337,6 +337,29 @@ class SecureFedAvgSim:
 
         from fedml_tpu.algorithms.fedavg import FedAvgSim
 
+        # the secure sum replaces server_update entirely: the protocol
+        # produces ONLY the weighted-mean delta, so server optimizers,
+        # momentum, and robustness preprocessing (which need per-client
+        # or reshaped aggregates) cannot apply. Refuse configs that ask
+        # for them rather than silently dropping the semantics.
+        f, t = cfg.fed, cfg.train
+        unsupported = {
+            "server_optimizer != 'sgd'": f.server_optimizer != "sgd",
+            "server_lr != 1.0": f.server_lr != 1.0,
+            "server_momentum": f.server_momentum != 0,
+            "gmf": f.gmf != 0,
+            "robust_method": f.robust_method not in (None, "", "mean"),
+            "robust_norm_clip": f.robust_norm_clip > 0,
+            "robust_noise_stddev": f.robust_noise_stddev > 0,
+            "fednova": f.algorithm == "fednova",
+        }
+        bad = [k for k, v in unsupported.items() if v]
+        if bad:
+            raise ValueError(
+                "secure aggregation (turboaggregate) computes a plain "
+                "weighted-mean update; unsupported settings: "
+                + ", ".join(bad)
+            )
         self.inner = FedAvgSim(model, data, cfg)
         cohort = min(cfg.fed.clients_per_round, cfg.data.num_clients)
         self.secure = SecureAggregator(
