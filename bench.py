@@ -79,8 +79,12 @@ def build_sim(num_clients=100, full_cifar=False, model_name="resnet56"):
         # bf16 compute; the headline takes the cohort-fused path
         # (fedml_tpu.models.cohort) whose step loop has a dynamic trip
         # count — scan_unroll only applies to the vmapped fallback path
+        # cohort_groups=5: size-sorted sub-groups of 2 clients, each with
+        # its own dynamic trip count — measured best on v5e for this
+        # 10-client cohort (57 -> 38 ms/round vs one lockstep group)
         train=TrainConfig(
-            lr=0.03, epochs=1, compute_dtype="bfloat16", scan_unroll=64
+            lr=0.03, epochs=1, compute_dtype="bfloat16", scan_unroll=64,
+            cohort_groups=5,
         ),
         fed=FedConfig(num_rounds=1000, clients_per_round=10, eval_every=10**9),
         seed=0,

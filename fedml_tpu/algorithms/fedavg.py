@@ -182,6 +182,57 @@ def server_update(
     )
 
 
+def _resolve_cohort_groups(requested: int, cohort: int) -> int:
+    """Number of size-sorted sub-groups the fused cohort runs in.
+    ``requested`` is capped at cohort // 2 (a group needs >= 2 clients)
+    and rounded DOWN to the nearest divisor of the cohort (static shapes
+    need equal groups); 0 = auto. Auto uses groups of ~5 clients:
+    measured on v5e the fat model's cost scales linearly down to C=5,
+    and per-group trip counts at that size already capture most of the
+    padding-waste reduction (see TrainConfig.cohort_groups)."""
+    if cohort <= 2:
+        return 1
+    want = requested if requested > 0 else max(1, round(cohort / 5))
+    want = max(1, min(want, cohort // 2))
+    while cohort % want:
+        want -= 1
+    return want
+
+
+def _grouped_cohort_call(
+    cohort_update, groups: int, variables, idx_rows, mask_rows, x, y, ckeys
+):
+    """Run the fused cohort update in ``groups`` size-sorted sub-groups.
+
+    Clients are sorted by sample count (descending) so each sub-group's
+    dynamic trip count is set by ITS largest member, not the cohort's;
+    results are unsorted back so callers see cohort order. Each client's
+    trajectory depends only on (globals, its rows, its key) — sorting and
+    grouping change scheduling, not numerics (same equality class as the
+    fused-vs-vmapped comparison, tests/test_cohort_conv.py)."""
+    if groups == 1:
+        return cohort_update(variables, idx_rows, mask_rows, x, y, ckeys)
+    C = idx_rows.shape[0]
+    sub = C // groups
+    order = jnp.argsort(-jnp.sum(mask_rows, axis=1))
+    inv = jnp.argsort(order)
+    idx_s, mask_s, keys_s = idx_rows[order], mask_rows[order], ckeys[order]
+    outs = []
+    for g in range(groups):
+        sl = slice(g * sub, (g + 1) * sub)
+        outs.append(
+            cohort_update(
+                variables, idx_s[sl], mask_s[sl], x, y, keys_s[sl]
+            )
+        )
+    cat = lambda *leaves: jnp.concatenate(leaves, axis=0)
+    stacked_vars, n_k, msums = (
+        jax.tree.map(cat, *[o[i] for o in outs]) for i in range(3)
+    )
+    unsort = lambda t: jax.tree.map(lambda v: v[inv], t)
+    return unsort(stacked_vars), n_k[inv], unsort(msums)
+
+
 class FedAvgSim:
     """Compiled federated simulation on one chip (see
     :mod:`fedml_tpu.parallel` for the mesh-sharded version)."""
@@ -212,9 +263,13 @@ class FedAvgSim:
         # ~3x on conv models — see fedml_tpu.models.cohort). Explicitly
         # disabled with TrainConfig(cohort_fused=False).
         cohort = min(cfg.fed.clients_per_round, cfg.data.num_clients)
+        self._cohort_groups = _resolve_cohort_groups(
+            cfg.train.cohort_groups, cohort
+        )
         self._cohort_update = (
             build_cohort_local_update(
-                model, self.task, cfg.train, self.batch_size, max_n, cohort
+                model, self.task, cfg.train, self.batch_size, max_n,
+                cohort // self._cohort_groups,
             )
             if cfg.train.cohort_fused
             and cohort_update_supported(model, cfg.train)
@@ -267,8 +322,14 @@ class FedAvgSim:
         mask_rows = arrays.mask[cohort]
 
         if self._cohort_update is not None:
-            stacked_vars, n_k, msums = self._cohort_update(
-                state.variables, idx_rows, mask_rows, arrays.x, arrays.y,
+            stacked_vars, n_k, msums = _grouped_cohort_call(
+                self._cohort_update,
+                self._cohort_groups,
+                state.variables,
+                idx_rows,
+                mask_rows,
+                arrays.x,
+                arrays.y,
                 ckeys,
             )
         else:

@@ -82,6 +82,16 @@ class TrainConfig:
     # and optimizer support it (same numerics, much faster conv lowering
     # on TPU — fedml_tpu.models.cohort). False = always vmap per client.
     cohort_fused: bool = True
+    # split the sampled cohort into this many size-sorted sub-groups, each
+    # with its own dynamic step-loop trip count (0 = auto). The fused
+    # cohort runs clients in lockstep to the LARGEST sampled client's
+    # step count; sorting by n_k and running sub-groups sequentially lets
+    # small clients' groups stop early, cutting the padding waste
+    # (executed steps: C*max -> sum over groups of Csub*max_g) while each
+    # client's own trajectory is untouched. Per-group cost scales
+    # linearly in group size on v5e (measured), so this is nearly free
+    # throughput. Ignored by the vmapped fallback (static trip count).
+    cohort_groups: int = 0
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1,0 +1,148 @@
+"""Break the headline bench round into components on the real chip.
+
+Times (fetch-corrected, amortized) for the s2d headline config:
+- full compiled round
+- cohort grad_fn alone (one step's fwd+bwd)
+- one step_body equivalent (grad + optimizer + gather + gating)
+- aggregation/server_update alone
+Usage: python scripts/profile_round.py [--model resnet56_s2d]
+"""
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timeit(fn, *args, n=30, warmup=2):
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    leaf = jax.tree.leaves(out)[0]
+    float(np.asarray(jax.device_get(jnp.sum(leaf))))
+    # fetch cost
+    fs = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        float(np.asarray(jax.device_get(jnp.sum(leaf))))
+        fs.append(time.perf_counter() - t0)
+    fetch = min(fs)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    leaf = jax.tree.leaves(out)[0]
+    float(np.asarray(jax.device_get(jnp.sum(leaf))))
+    wall = time.perf_counter() - t0
+    return max(wall - fetch, wall / 2) / n
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet56_s2d")
+    args = ap.parse_args()
+
+    import sys
+    sys.path.insert(0, str(__import__("pathlib").Path(__file__).resolve().parent.parent))
+    from bench import build_sim
+
+    sim, data = build_sim(model_name=args.model)
+    state = sim.init()
+    compiled = jax.jit(sim._round).lower(state, sim.arrays).compile()
+    t_round = timeit(lambda s: compiled(s, sim.arrays)[0], state, n=40)
+    print(f"full round: {t_round*1e3:.2f} ms  ({1/t_round:.1f} r/s)")
+
+    counts = np.asarray(sim.arrays.counts)
+    print(f"counts: mean={counts.mean():.0f} max={counts.max()} "
+          f"mean_steps={np.mean(np.ceil(counts/32)):.2f} "
+          f"max_steps={np.ceil(counts.max()/32):.0f}")
+
+    # --- cohort grad_fn alone ---
+    from fedml_tpu.algorithms.base import (
+        build_cohort_local_update, make_task, make_client_optimizer,
+        _tree_to_dtype, _static_vars_to_dtype,
+    )
+    import optax
+    model = sim.model
+    C, B = 10, 32
+    task = make_task("classification")
+    cfg = sim.cfg.train
+    compute_dtype = jnp.dtype(cfg.compute_dtype)
+
+    variables = model.init(jax.random.key(0))
+    stacked = jax.tree.map(
+        lambda v: jnp.broadcast_to(v[None], (C,) + v.shape) + 0.0, variables
+    )
+    x_cb = jnp.zeros((C, B, 32, 32, 3), jnp.float32) + 0.1
+    y_cb = jnp.zeros((C, B), jnp.int32)
+    w_cb = jnp.ones((C, B), jnp.float32)
+
+    def loss_fn(stacked_params, static_stacked, x_cb, y_cb, w_cb, rng):
+        variables = {
+            **_static_vars_to_dtype(static_stacked, compute_dtype),
+            "params": _tree_to_dtype(stacked_params, compute_dtype),
+        }
+        logits, new_vars = model.apply_cohort_train(
+            variables, _tree_to_dtype(x_cb, compute_dtype), rng
+        )
+        sums = jax.vmap(task.metric_sums)(
+            logits.astype(jnp.float32), y_cb, w_cb
+        )
+        loss = jnp.sum(sums["loss_sum"] / jnp.maximum(sums["w_sum"], 1.0))
+        return loss, (new_vars, sums)
+
+    grad_fn = jax.jit(jax.value_and_grad(loss_fn, has_aux=True))
+    sp = stacked["params"]
+    ss = {k: v for k, v in stacked.items() if k != "params"}
+    rng = jax.random.key(1)
+    t_grad = timeit(
+        lambda p: grad_fn(p, ss, x_cb, y_cb, w_cb, rng)[1], sp, n=40
+    )
+    print(f"cohort grad_fn: {t_grad*1e3:.2f} ms")
+
+    # --- grad + optimizer + gating (one full step body, minus data gather) ---
+    opt = make_client_optimizer(cfg)
+    opt_state = jax.vmap(opt.init)(sp)
+
+    @jax.jit
+    def step(variables, opt_state):
+        params = variables["params"]
+        sv = {k: v for k, v in variables.items() if k != "params"}
+        (_, (new_vars, sums)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(params, sv, x_cb, y_cb, w_cb, rng)
+        updates, new_opt = opt.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        valid = sums["w_sum"] > 0
+        sel = lambda n_, o: jax.tree.map(
+            lambda a, b: jnp.where(
+                valid.reshape((C,) + (1,) * (a.ndim - 1)), a, b
+            ), n_, o,
+        )
+        return sel({**new_vars, "params": new_params}, variables), sel(
+            new_opt, opt_state
+        )
+
+    t_step = timeit(lambda v: step(v, opt_state)[0], stacked, n=40)
+    print(f"step body (no gather): {t_step*1e3:.2f} ms")
+
+    # --- data gather ---
+    x = jnp.asarray(sim.arrays.x)
+    b_idx = jnp.zeros((C, B), jnp.int32)
+
+    @jax.jit
+    def gather(b_idx):
+        return jnp.take(x, b_idx, axis=0)
+
+    t_g = timeit(gather, b_idx, n=40)
+    print(f"data gather: {t_g*1e3:.3f} ms")
+
+    # implied steps from the round
+    print(f"implied: round={t_round*1e3:.1f}ms; if k steps of "
+          f"{t_step*1e3:.2f}ms -> k={t_round/t_step:.1f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,118 @@
+"""Microbench the cohort-grouped s2d step internals: conv trunk vs BN vs
+layouts, per-op grouped conv rates, and sub-cohort scaling (C=5 vs C=10).
+"""
+from __future__ import annotations
+
+import time
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+sys.path.insert(0, str(__import__("pathlib").Path(__file__).resolve().parent.parent))
+
+
+def timeit(fn, *args, n=40, warmup=2):
+    for _ in range(warmup):
+        out = fn(*args)
+    leaf = jax.tree.leaves(out)[0]
+    float(np.asarray(jax.device_get(jnp.sum(leaf))))
+    fs = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        float(np.asarray(jax.device_get(jnp.sum(leaf))))
+        fs.append(time.perf_counter() - t0)
+    fetch = min(fs)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    leaf = jax.tree.leaves(out)[0]
+    float(np.asarray(jax.device_get(jnp.sum(leaf))))
+    wall = time.perf_counter() - t0
+    return max(wall - fetch, wall / 2) / n
+
+
+def conv_flops(B, H, W, k, ci, co):
+    return 2 * B * H * W * k * k * ci * co
+
+
+def bench_grouped_conv(B, H, W, cpg, C, k=3, n=40):
+    """One grouped conv fwd+bwd (dw+dx via grad) at given shape."""
+    ci = cpg * C
+    x = jnp.ones((B, H, W, ci), jnp.bfloat16) * 0.01
+    w = jnp.ones((k, k, cpg, ci), jnp.bfloat16) * 0.01
+
+    def loss(x, w):
+        y = lax.conv_general_dilated(
+            x, w, (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=C,
+        )
+        return jnp.sum(y.astype(jnp.float32) ** 2)
+
+    g = jax.jit(jax.grad(loss, argnums=(0, 1)))
+    t = timeit(lambda: g(x, w), n=n)
+    fl = 3 * conv_flops(B, H, W, k, cpg, cpg) * C  # useful fwd+dx+dw
+    return t, fl / t / 1e12
+
+
+def main():
+    print("== grouped conv fwd+bwd rates (useful TFLOP/s, v5e peak 197) ==")
+    for (B, H, W, cpg, C, tag) in [
+        (32, 16, 16, 64, 10, "s2d stage1"),
+        (32, 16, 16, 32, 10, "s2d stage2"),
+        (32, 8, 8, 64, 10, "s2d stage3"),
+        (32, 16, 16, 64, 1, "dense 64 (1 client)"),
+        (32, 16, 16, 640, 1, "dense 640 (shared-floor)"),
+        (32, 32, 32, 16, 10, "std stage1 (16cpg)"),
+    ]:
+        t, r = bench_grouped_conv(B, H, W, cpg, C)
+        print(f"{tag:24s} t={t*1e3:7.3f} ms useful={r:6.2f} TF/s "
+              f"mfu={r/197*100:5.1f}%")
+
+    # full fat-model grad with and without BN
+    from fedml_tpu.models import create_model
+    from fedml_tpu.config import ModelConfig
+
+    for C in (10, 5):
+        for extra, tag in [((), "bn"), ((("norm", "gn"),), "gn")]:
+            cfgm = ModelConfig(
+                name="resnet56_s2d", num_classes=10,
+                input_shape=(32, 32, 3), extra=extra,
+            )
+            try:
+                model = create_model(cfgm)
+            except Exception as e:
+                print("skip", tag, e)
+                continue
+            variables = model.init(jax.random.key(0))
+            stacked = jax.tree.map(
+                lambda v: jnp.broadcast_to(v[None], (C,) + v.shape) + 0.0,
+                variables,
+            )
+            x_cb = jnp.ones((C, 32, 32, 32, 3), jnp.bfloat16) * 0.1
+
+            def loss_fn(sp, ss, x):
+                from fedml_tpu.algorithms.base import (
+                    _tree_to_dtype, _static_vars_to_dtype,
+                )
+                var = {
+                    **_static_vars_to_dtype(ss, jnp.bfloat16),
+                    "params": _tree_to_dtype(sp, jnp.bfloat16),
+                }
+                logits, new_vars = model.apply_cohort_train(
+                    var, x, jax.random.key(0)
+                )
+                return jnp.sum(logits.astype(jnp.float32) ** 2), new_vars
+
+            sp = stacked["params"]
+            ss = {k: v for k, v in stacked.items() if k != "params"}
+            g = jax.jit(jax.grad(loss_fn, has_aux=True))
+            t = timeit(lambda: g(sp, ss, x_cb), n=30)
+            print(f"fat model C={C} norm={tag}: grad {t*1e3:.2f} ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -227,3 +227,41 @@ def test_space_to_depth_resnet_variant():
     for _ in range(2):
         st, m = sim.run_round(st)
     assert np.isfinite(float(m["train_loss"]))
+
+
+def test_cohort_groups_equal_single_group():
+    """Size-sorted sub-group scheduling (TrainConfig.cohort_groups) must
+    not change any client's trajectory: the aggregated state after rounds
+    with cohort_groups=2 equals the single-group fused run (same equality
+    class as fused-vs-vmapped; exact here because the model is BN-free)."""
+    base = dict(
+        data=DataConfig(
+            dataset="fake_cifar10", num_clients=12, batch_size=16, seed=0,
+            partition_method="hetero", partition_alpha=0.5, dataset_r=0.2,
+        ),
+        model=ModelConfig(
+            name="cnn_custom", num_classes=10, input_shape=(32, 32, 3),
+            extra=(("convs", (8, 16)), ("denses", (32,))),
+        ),
+        fed=FedConfig(num_rounds=3, clients_per_round=6, eval_every=10),
+        seed=0,
+    )
+    states = {}
+    for groups in (1, 2):
+        cfg = ExperimentConfig(
+            **base,
+            train=TrainConfig(lr=0.05, epochs=1, cohort_groups=groups),
+        )
+        data = load_dataset(cfg.data)
+        sim = FedAvgSim(create_model(cfg.model), data, cfg)
+        assert sim._cohort_update is not None, "fused path must be active"
+        assert sim._cohort_groups == groups
+        st = sim.init()
+        for _ in range(3):
+            st, _ = sim.run_round(st)
+        states[groups] = st
+    a = jax.tree.leaves(states[1].variables["params"])
+    b = jax.tree.leaves(states[2].variables["params"])
+    for x, y in zip(a, b):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   rtol=2e-5, atol=2e-6)
