@@ -1,7 +1,11 @@
 """Headline benchmark: FedAvg rounds/sec, 100 clients, CIFAR10-shaped data,
 ResNet-56 (BASELINE.json "metric").
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...} with
+A plain run prints FOUR JSON lines — standard-ResNet56 rate (reference-
+layout comparability), the north-star 1000-client non-IID shape,
+time-to-80%-accuracy on the learnable procedural CIFAR stand-in, and
+LAST the s2d headline (the default TPU story; the driver parses the last
+line). Each line is {"metric", "value", "unit", "vs_baseline", ...} with
 supplementary fields:
 
 - ``delivered_tflops`` / ``mfu``: USEFUL FLOP/s — the work the FedAvg
@@ -38,6 +42,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import sys
 import time
 
 import numpy as np
@@ -113,10 +118,19 @@ def build_sim(num_clients=100, full_cifar=False, model_name="resnet56"):
 
 
 def torch_baseline_round_seconds(
-    steps_per_client: int, clients_per_round: int, batch_size: int = 32
+    steps_per_client: int,
+    clients_per_round: int,
+    batch_size: int = 32,
+    s2d: bool = False,
 ) -> float:
     """Per-round wall-clock of the reference-style serial torch loop,
-    extrapolated from a few timed ResNet-56 fwd+bwd batches."""
+    extrapolated from a few timed ResNet-56 fwd+bwd batches. With
+    ``s2d=True`` the torch net is the SAME space-to-depth
+    parameterization the s2d metrics run (stem rearrange + widths
+    (4w, 2w, 4w), strides (1, 1, 2)), so s2d vs_baseline is
+    apples-to-apples. Timing policy mirrors the framework side: best of
+    3 windows (symmetric estimator — see the window policy note in
+    main())."""
     import torch
     import torch.nn as nn
 
@@ -141,14 +155,27 @@ def torch_baseline_round_seconds(
             y = self.b2(self.c2(y))
             return torch.relu(y + self.short(x))
 
-    layers = [nn.Conv2d(3, 16, 3, 1, 1, bias=False), nn.BatchNorm2d(16), nn.ReLU()]
-    cin = 16
-    for stage, ch in enumerate((16, 32, 64)):
+    if s2d:
+        widths, strides, cin0 = (64, 32, 64), (1, 1, 2), 12
+        stem = [nn.PixelUnshuffle(2)]  # [B,3,32,32] -> [B,12,16,16]
+    else:
+        widths, strides, cin0 = (16, 32, 64), (1, 2, 2), 3
+        stem = []
+    layers = stem + [
+        nn.Conv2d(cin0, widths[0], 3, 1, 1, bias=False),
+        nn.BatchNorm2d(widths[0]),
+        nn.ReLU(),
+    ]
+    cin = widths[0]
+    for stage, (ch, st) in enumerate(zip(widths, strides)):
         for blk in range(9):  # 6*9+2 = 56
-            layers.append(Block(cin, ch, 2 if (stage > 0 and blk == 0) else 1))
+            layers.append(
+                Block(cin, ch, st if (stage > 0 and blk == 0) else 1)
+            )
             cin = ch
     net = nn.Sequential(
-        *layers, nn.AdaptiveAvgPool2d(1), nn.Flatten(), nn.Linear(64, 10)
+        *layers, nn.AdaptiveAvgPool2d(1), nn.Flatten(),
+        nn.Linear(widths[-1], 10)
     )
     opt = torch.optim.SGD(net.parameters(), lr=0.03)
     lossf = nn.CrossEntropyLoss()
@@ -161,12 +188,19 @@ def torch_baseline_round_seconds(
         opt.step()
 
     step()  # warmup
-    t0 = time.perf_counter()
-    n_timed = 3
-    for _ in range(n_timed):
-        step()
-    per_batch = (time.perf_counter() - t0) / n_timed
-    return per_batch * steps_per_client * clients_per_round
+    # best of 3 windows of 2 steps — the SAME estimator policy as the
+    # framework side, so vs_baseline compares like to like
+    best = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(2):
+            step()
+        per_batch = (time.perf_counter() - t0) / 2
+        best = per_batch if best is None else min(best, per_batch)
+    return best * steps_per_client * clients_per_round
+
+
+_COST_CACHE: dict = {}
 
 
 def useful_round_cost(sim):
@@ -205,24 +239,30 @@ def useful_round_cost(sim):
             logits.astype(jnp.float32), y
         ).mean()
 
-    variables = model.init(jax.random.key(0))
-    params = variables["params"]
-    static_vars = {k: v for k, v in variables.items() if k != "params"}
-    x = jnp.zeros((B,) + tuple(sim.cfg.model.input_shape), jnp.float32)
-    y = jnp.zeros((B,), jnp.int32)
-    try:
-        ca = (
-            jax.jit(jax.grad(step_loss))
-            .lower(params, static_vars, x, y)
-            .compile()
-            .cost_analysis()
-        )
-        if isinstance(ca, list):
-            ca = ca[0]
-        step_flops = float(ca.get("flops") or 0) or None
-        step_bytes = float(ca.get("bytes accessed") or 0) or None
-    except Exception:
-        return None, None
+    cost_key = (sim.cfg.model.name, tuple(sim.cfg.model.input_shape), B,
+                str(compute_dtype))
+    if cost_key in _COST_CACHE:
+        step_flops, step_bytes = _COST_CACHE[cost_key]
+    else:
+        variables = model.init(jax.random.key(0))
+        params = variables["params"]
+        static_vars = {k: v for k, v in variables.items() if k != "params"}
+        x = jnp.zeros((B,) + tuple(sim.cfg.model.input_shape), jnp.float32)
+        y = jnp.zeros((B,), jnp.int32)
+        try:
+            ca = (
+                jax.jit(jax.grad(step_loss))
+                .lower(params, static_vars, x, y)
+                .compile()
+                .cost_analysis()
+            )
+            if isinstance(ca, list):
+                ca = ca[0]
+            step_flops = float(ca.get("flops") or 0) or None
+            step_bytes = float(ca.get("bytes accessed") or 0) or None
+        except Exception:
+            return None, None
+        _COST_CACHE[cost_key] = (step_flops, step_bytes)
     counts = np.asarray(sim.arrays.counts)
     mean_steps = float(np.mean(np.ceil(counts / B)))
     k = sim.cfg.fed.clients_per_round * mean_steps * sim.cfg.train.epochs
@@ -232,80 +272,67 @@ def useful_round_cost(sim):
     )
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--rounds", type=int, default=15)
-    ap.add_argument("--skip-torch-baseline", action="store_true")
-    ap.add_argument("--northstar", action="store_true")
-    ap.add_argument(
-        "--s2d",
-        action="store_true",
-        help="bench the resnet56_s2d space-to-depth parameterization "
-        "(same FLOP class/depth, TPU-friendly widths; separate metric "
-        "name — not comparable to reference checkpoints)",
-    )
-    ap.add_argument("--target-acc", type=float, default=None)
-    ap.add_argument("--max-rounds", type=int, default=2000)
-    args = ap.parse_args()
-
+def _enable_compile_cache():
+    """Persistent XLA compilation cache: the driver runs this script
+    fresh every round and the suite compiles ~5 programs; caching them
+    across processes cuts the suite from ~10+ min to ~2-3."""
     import jax
 
-    model_name = "resnet56_s2d" if args.s2d else "resnet56"
-    if args.northstar:
-        sim, data = build_sim(
-            num_clients=1000, full_cifar=True, model_name=model_name
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir", "/tmp/fedml_tpu_xla_cache"
         )
-        metric = f"fedavg_rounds_per_sec_1000c_noniid_cifar10_{model_name}"
-    else:
-        sim, data = build_sim(model_name=model_name)
-        metric = f"fedavg_rounds_per_sec_100c_cifar10_{model_name}"
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass  # older jax or unsupported backend: compile uncached
+
+
+_ROUND_CACHE: dict = {}
+
+
+def _compiled_round(sim, cache: bool = False):
+    """AOT-compile the round ONCE; the same executable serves warmup and
+    the timed loop (utilization numbers come from useful_round_cost's
+    separate single-step program — the round's own cost analysis is
+    meaningless with a data-dependent trip count). ``cache=True`` reuses
+    the executable across suite stages sharing ONE sim (tta + headline);
+    cached entries pin the sim's device arrays, so the default suite only
+    caches the stage pair that benefits and clears afterwards."""
+    import jax
 
     state = sim.init()
-    # AOT-compile the round ONCE; the same executable serves warmup and
-    # the timed loop (utilization numbers come from useful_round_cost's
-    # separate single-step program — the round's own cost analysis is
-    # meaningless with a data-dependent trip count)
-    compiled = jax.jit(sim._round, donate_argnums=(0,)).lower(
-        state, sim.arrays
-    ).compile()
-    run_round = lambda st: compiled(st, sim.arrays)
-    # warmup (execute once)
-    state, _ = run_round(state)
+    run_round = _ROUND_CACHE.get(id(sim)) if cache else None
+    if run_round is None:
+        compiled = jax.jit(sim._round, donate_argnums=(0,)).lower(
+            state, sim.arrays
+        ).compile()
+        run_round = lambda st: compiled(st, sim.arrays)
+        if cache:
+            _ROUND_CACHE[id(sim)] = run_round
+    state, _ = run_round(state)  # warmup (execute once)
     jax.block_until_ready(state.variables)
+    return run_round, state
 
-    if args.target_acc is not None:
-        sim.evaluate_global(state)  # warm the evaluator compile before t0
-        t0 = time.perf_counter()
-        reached = None
-        for r in range(args.max_rounds):
-            state, _ = run_round(state)
-            if (r + 1) % 10 == 0:
-                acc = sim.evaluate_global(state)["acc"]
-                if acc >= args.target_acc:
-                    reached = time.perf_counter() - t0
-                    break
-        print(
-            json.dumps(
-                {
-                    "metric": f"time_to_{args.target_acc}_acc_{model_name}",
-                    "value": round(reached, 2) if reached else None,
-                    "unit": "seconds",
-                    "vs_baseline": None,
-                }
-            )
-        )
-        return
 
-    # The tunnelled backend occasionally stalls for seconds on a single
-    # dispatch; a one-window average would record that noise as the
-    # framework's round rate. Take the BEST of three fetch-corrected
-    # windows — transient stalls only ever slow a window down, so the
-    # fastest window is the honest capability number. The fetch cost is
-    # the MIN of three device_get samples (a stalled sample must not
-    # poison the correction), and the correction is capped at half the
-    # window so a bad estimate can never manufacture a rate faster than
-    # physically measured by more than 2x. (block_until_ready alone has
-    # been observed not to wait here; device_get is the only real sync.)
+def rate_bench(sim, rounds: int, cache: bool = False):
+    """Fetch-corrected round rate over 3 windows.
+
+    The tunnelled backend occasionally stalls for seconds on a single
+    dispatch; a one-window average would record that noise as the
+    framework's round rate. ``value`` is the BEST of three fetch-corrected
+    windows — transient stalls only ever slow a window down, so the
+    fastest window is the honest capability number — and
+    ``value_median`` + ``window_rates`` bracket it so readers see the
+    spread (the torch baseline uses the same best-of policy, keeping
+    vs_baseline symmetric). The fetch cost is the MIN of three device_get
+    samples (a stalled sample must not poison the correction), and the
+    correction is capped at half the window so a bad estimate can never
+    manufacture a rate faster than physically measured by more than 2x.
+    (block_until_ready alone has been observed not to wait here;
+    device_get is the only real sync.)"""
+    import jax
+
+    run_round, state = _compiled_round(sim, cache=cache)
     fetch_samples = []
     for _ in range(3):
         t0 = time.perf_counter()
@@ -313,10 +340,10 @@ def main():
         fetch_samples.append(time.perf_counter() - t0)
     fetch_cost = min(fetch_samples)
 
-    windows = min(3, args.rounds)
-    per = args.rounds // windows
+    windows = min(3, rounds)
+    per = rounds // windows
     sizes = [per] * windows
-    sizes[-1] += args.rounds - per * windows  # execute exactly --rounds
+    sizes[-1] += rounds - per * windows  # execute exactly --rounds
     rates = []
     for size in sizes:
         t0 = time.perf_counter()
@@ -326,8 +353,14 @@ def main():
         wall = time.perf_counter() - t0
         dt = max(wall - fetch_cost, wall / 2)
         rates.append(size / dt)
-    rps = max(rates)
+    return max(rates), float(np.median(rates)), rates
 
+
+def rate_record(sim, metric: str, rounds: int, s2d: bool,
+                skip_torch: bool, cache: bool = False) -> dict:
+    import jax
+
+    rps, rps_median, rates = rate_bench(sim, rounds, cache=cache)
     flops, bbytes = useful_round_cost(sim)
     kind = jax.devices()[0].device_kind
     peak_flops, peak_bw = PEAKS.get(kind, (None, None))
@@ -336,37 +369,143 @@ def main():
     hbm = bbytes * rps / peak_bw if bbytes and peak_bw else None
 
     vs = float("nan")
-    if args.s2d:
-        # the torch baseline times the standard ResNet-56; comparing the
-        # s2d parameterization against it would be apples-to-oranges, so
-        # the s2d metric reports vs_baseline = null by construction
-        args.skip_torch_baseline = True
-    if not args.skip_torch_baseline:
+    if not skip_torch:
         # the reference serial loop runs ceil(n_k/B) real batches per
-        # sampled client — use the mean over clients, NOT the padded max
+        # sampled client — use the mean over clients, NOT the padded max.
+        # For s2d metrics the torch net is the same s2d parameterization.
         counts = np.asarray(sim.arrays.counts)
         steps_per_client = float(
             np.mean(np.ceil(counts / sim.batch_size))
         )
-        base_round_s = torch_baseline_round_seconds(steps_per_client, 10)
-        vs = rps * base_round_s  # ratio of round rates
-
-    print(
-        json.dumps(
-            {
-                "metric": metric,
-                "value": round(rps, 4),
-                "unit": "rounds/sec",
-                "vs_baseline": round(vs, 2) if np.isfinite(vs) else None,
-                "delivered_tflops": round(delivered / 1e12, 3)
-                if delivered
-                else None,
-                "mfu": round(mfu, 4) if mfu else None,
-                "hbm_util": round(hbm, 4) if hbm else None,
-                "device": kind,
-            }
+        base_round_s = torch_baseline_round_seconds(
+            steps_per_client, sim.cfg.fed.clients_per_round, s2d=s2d
         )
+        vs = rps * base_round_s  # ratio of round rates
+    return {
+        "metric": metric,
+        "value": round(rps, 4),
+        "unit": "rounds/sec",
+        "vs_baseline": round(vs, 2) if np.isfinite(vs) else None,
+        "value_median": round(rps_median, 4),
+        "window_rates": [round(r, 4) for r in rates],
+        "delivered_tflops": round(delivered / 1e12, 3) if delivered
+        else None,
+        "mfu": round(mfu, 4) if mfu else None,
+        "hbm_util": round(hbm, 4) if hbm else None,
+        "device": kind,
+    }
+
+
+def time_to_acc_record(sim, model_name: str, target: float,
+                       max_rounds: int, cache: bool = False) -> dict:
+    """Wall-clock (and rounds) to reach ``target`` test accuracy — the
+    convergence-speed evidence behind the north-star claim, on the
+    LEARNABLE procedural CIFAR stand-in (class prototypes + noise; real
+    CIFAR files are not on the offline bench host)."""
+    run_round, state = _compiled_round(sim, cache=cache)
+    sim.evaluate_global(state)  # warm the evaluator compile before t0
+    t0 = time.perf_counter()
+    reached, rounds_used, acc = None, None, 0.0
+    for r in range(max_rounds):
+        state, _ = run_round(state)
+        if (r + 1) % 5 == 0:
+            acc = sim.evaluate_global(state)["acc"]
+            if acc >= target:
+                reached = time.perf_counter() - t0
+                rounds_used = r + 1
+                break
+    return {
+        "metric": f"time_to_{target}_acc_{model_name}",
+        "value": round(reached, 2) if reached else None,
+        "unit": "seconds",
+        "vs_baseline": None,
+        "rounds": rounds_used,
+        "final_acc": round(float(acc), 4),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="Plain `python bench.py` (what the driver runs) "
+        "emits FOUR JSON lines: standard-ResNet56 rate, north-star-shape "
+        "rate, time-to-accuracy, and LAST the s2d headline (the default "
+        "TPU story, BASELINE.json metric class). Flags narrow the run "
+        "to a single metric."
     )
+    ap.add_argument("--rounds", type=int, default=15)
+    ap.add_argument("--skip-torch-baseline", action="store_true")
+    ap.add_argument("--northstar", action="store_true",
+                    help="ONLY the north-star 1000-client non-IID shape")
+    ap.add_argument(
+        "--s2d",
+        action="store_true",
+        help="ONLY the resnet56_s2d headline (space-to-depth "
+        "parameterization: same FLOP class/depth, TPU-friendly widths; "
+        "vs_baseline uses the same s2d net in torch)",
+    )
+    ap.add_argument("--std", action="store_true",
+                    help="ONLY the standard resnet56 metric")
+    ap.add_argument("--target-acc", type=float, default=None,
+                    help="ONLY time-to-accuracy at this target")
+    ap.add_argument("--max-rounds", type=int, default=2000)
+    args = ap.parse_args()
+
+    _enable_compile_cache()
+    t_start = time.perf_counter()
+
+    def emit(rec):
+        print(json.dumps(rec), flush=True)
+        print(
+            f"[bench] {rec['metric']} done at "
+            f"t+{time.perf_counter() - t_start:.0f}s",
+            file=sys.stderr,
+            flush=True,
+        )
+
+    if args.target_acc is not None:
+        model_name = "resnet56_s2d" if args.s2d else "resnet56"
+        sim, _ = build_sim(model_name=model_name)
+        emit(time_to_acc_record(sim, model_name, args.target_acc,
+                                args.max_rounds))
+        return
+    if args.northstar or args.s2d or args.std:
+        model_name = "resnet56" if args.std else "resnet56_s2d"
+        if args.northstar:
+            sim, _ = build_sim(num_clients=1000, full_cifar=True,
+                               model_name=model_name)
+            metric = (
+                f"fedavg_rounds_per_sec_1000c_noniid_cifar10_{model_name}"
+            )
+        else:
+            sim, _ = build_sim(model_name=model_name)
+            metric = f"fedavg_rounds_per_sec_100c_cifar10_{model_name}"
+        emit(rate_record(sim, metric, args.rounds,
+                         model_name.endswith("_s2d"),
+                         args.skip_torch_baseline))
+        return
+
+    # ---- default: the full driver suite, headline LAST ----
+    sim, _ = build_sim(model_name="resnet56")
+    emit(rate_record(
+        sim, "fedavg_rounds_per_sec_100c_cifar10_resnet56",
+        args.rounds, False, args.skip_torch_baseline,
+    ))
+    del sim
+    ns, _ = build_sim(num_clients=1000, full_cifar=True,
+                      model_name="resnet56_s2d")
+    emit(rate_record(
+        ns, "fedavg_rounds_per_sec_1000c_noniid_cifar10_resnet56_s2d",
+        args.rounds, True, args.skip_torch_baseline,
+    ))
+    del ns
+    s2d_sim, _ = build_sim(model_name="resnet56_s2d")
+    emit(time_to_acc_record(s2d_sim, "resnet56_s2d", 0.8, 1000,
+                            cache=True))
+    emit(rate_record(
+        s2d_sim, "fedavg_rounds_per_sec_100c_cifar10_resnet56_s2d",
+        args.rounds, True, args.skip_torch_baseline, cache=True,
+    ))
+    _ROUND_CACHE.clear()
 
 
 if __name__ == "__main__":

@@ -496,6 +496,13 @@ def build_cohort_local_update(
                 w_b = jnp.take_along_axis(mask_rows, take, axis=1)
                 x_b = jnp.take(x, b_idx, axis=0)
                 y_b = jnp.take(y, b_idx, axis=0)
+                # ONE key for the whole cohort, derived from client 0's
+                # epoch key — safe only because cohort eligibility
+                # (FedModel.supports_cohort) excludes stochastic layers:
+                # apply_cohort_train never consumes this rng. A future
+                # cohort-eligible model that does would need per-client
+                # keys (vmap fold_in over ekeys) threaded into the fat
+                # module instead.
                 skey = jax.random.fold_in(ekeys[0], step)
                 params = variables["params"]
                 static_vars = {

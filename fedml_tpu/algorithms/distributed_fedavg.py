@@ -35,6 +35,13 @@ from fedml_tpu.core.message import (
 from fedml_tpu.core.transport.base import BaseTransport
 from fedml_tpu.data.federated import FederatedData, arrays_and_batch
 from fedml_tpu.algorithms.base import build_local_update, make_task
+from fedml_tpu.algorithms.fedavg import (
+    ServerState,
+    local_reducer,
+    make_server_optimizer,
+    server_update,
+)
+from fedml_tpu.core import random as RND
 from fedml_tpu.models.base import FedModel
 
 
@@ -50,12 +57,53 @@ class FedAvgServerActor(ServerManager):
         cfg: ExperimentConfig,
         num_clients: int,
         on_round_done: Callable[[int, dict], None] | None = None,
+        initial_variables=None,
+        steps_per_epoch: int | None = None,
+        batch_size: int | None = None,
+        data: FederatedData | None = None,
     ):
         super().__init__(0, size, transport)
         self.cfg = cfg
         self.num_clients = num_clients
         self.model = model
-        self.variables = model.init(jax.random.key(cfg.seed))
+        variables = (
+            initial_variables
+            if initial_variables is not None
+            else model.init(jax.random.key(cfg.seed))
+        )
+        opt = make_server_optimizer(
+            cfg.fed.server_optimizer, cfg.fed.server_lr,
+            cfg.fed.server_momentum,
+        )
+        # full ServerState so EVERY server rule the compiled sim supports
+        # (FedOpt adam/adagrad/yogi pseudo-gradients, FedNova
+        # tau-normalization + gmf momentum, robust clip/noise/median/
+        # trimmed-mean) runs over the actor runtime too — the transport
+        # zoo's second consumer (ref fedopt/FedOptAggregator.py)
+        self.state = ServerState(
+            variables=variables,
+            opt_state=opt.init(variables["params"]),
+            momentum=jax.tree.map(jnp.zeros_like, variables["params"]),
+            round=jnp.asarray(0, jnp.int32),
+        )
+        # FedNova's tau normalization needs the RESOLVED batch size and
+        # steps_per_epoch (arrays_and_batch handles full-batch mode and
+        # batch > max_n clamping) — pass `data` or the explicit values;
+        # raw cfg.data.batch_size would silently skew tau.
+        if data is not None and (steps_per_epoch is None
+                                 or batch_size is None):
+            arrays, rbatch = arrays_and_batch(data, cfg.data)
+            batch_size = rbatch if batch_size is None else batch_size
+            if steps_per_epoch is None:
+                steps_per_epoch = arrays.max_client_samples // rbatch
+        if cfg.fed.algorithm == "fednova" and steps_per_epoch is None:
+            raise ValueError(
+                "fednova server rule needs steps_per_epoch/batch_size: "
+                "pass data= (resolved automatically) or both values"
+            )
+        self.steps_per_epoch = steps_per_epoch or 1
+        self.batch_size = batch_size or cfg.data.batch_size
+        self.root_key = jax.random.key(cfg.seed)
         self.round_idx = 0
         self._results: dict[int, tuple[dict, float]] = {}
         self._lock = threading.Lock()
@@ -64,6 +112,10 @@ class FedAvgServerActor(ServerManager):
         self.register_message_receive_handler(
             MSG_TYPE_C2S_RESULT, self._handle_result
         )
+
+    @property
+    def variables(self):
+        return self.state.variables
 
     def _sample(self) -> np.ndarray:
         """Seeded cohort sampling (reference ``client_sampling``,
@@ -99,11 +151,26 @@ class FedAvgServerActor(ServerManager):
                 return
             results = self._results
             self._results = {}
-        # all received: aggregate (reference
-        # handle_message_receive_model_from_client, FedAvgServerManager.py:45-82)
-        stacked = T.tree_stack([v for v, _ in results.values()])
-        weights = jnp.asarray([n for _, n in results.values()])
-        self.variables = T.tree_weighted_mean(stacked, weights)
+        # all received: aggregate through the SAME server_update as the
+        # compiled sim (reference handle_message_receive_model_from_client,
+        # FedAvgServerManager.py:45-82 + fedopt/FedOptAggregator.py) — the
+        # two paths cannot drift
+        stacked = T.tree_stack(
+            [results[r][0] for r in sorted(results)]
+        )
+        weights = jnp.asarray([results[r][1] for r in sorted(results)])
+        rkey = RND.round_key(self.root_key, self.state.round)
+        self.state = server_update(
+            self.cfg.fed,
+            self.cfg.train,
+            self.steps_per_epoch,
+            self.batch_size,
+            self.state,
+            jax.tree.map(jnp.asarray, stacked),
+            weights,
+            rkey,
+            local_reducer(),
+        )
         self.round_idx += 1
         if self.on_round_done is not None:
             self.on_round_done(self.round_idx, {"num_results": len(results)})

@@ -1,0 +1,867 @@
+"""Split-compute FL across a REAL transport boundary.
+
+The compiled sims in :mod:`fedml_tpu.algorithms.split` run both halves of
+each split algorithm inside one XLA program (joint autodiff across the
+cut). These actors run the same math as two (or more) processes
+exchanging :class:`~fedml_tpu.core.message.Message`s over any
+``BaseTransport`` backend — the trust/process boundary the reference
+deploys:
+
+- **SplitNN** (``fedml_api/distributed/split_nn/client.py:24-34``,
+  ``server.py:40-57``): every batch ships activations+labels up and the
+  cut gradient back; clients take turns around the ring while the server
+  weights persist.
+- **FedGKT** (``fedml_api/distributed/fedgkt/GKTClientTrainer.py:50``):
+  clients ship extracted feature maps + logits + labels; the server
+  trains the upper trunk on the received banks and returns per-sample
+  teacher logits.
+- **Vertical FL**
+  (``fedml_api/standalone/classical_vertical_fl/guest_trainer.py:10``,
+  ``party_models.py``): hosts ship per-batch logit components; the guest
+  (label owner) returns the common gradient d loss / d component.
+
+Equality contract: every actor derives batch order, rng keys, optimizer
+state, and update gating exactly as its compiled sim does, so a
+loopback/gRPC run matches the sim to float round-off (the backward pass
+across the cut is the same chain rule the joint autodiff executes) —
+pinned per algorithm in ``tests/test_split_actors.py``.
+
+All handlers are event-driven state machines (the transport drain is
+single-threaded; a handler that blocked waiting for the reply would
+deadlock the inbox).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from fedml_tpu.config import ExperimentConfig
+from fedml_tpu.core import random as R
+from fedml_tpu.core.manager import ClientManager, Manager, ServerManager
+from fedml_tpu.core.message import Message
+from fedml_tpu.core.transport.base import BaseTransport
+from fedml_tpu.data.federated import FederatedData, arrays_and_batch
+from fedml_tpu.algorithms.base import make_client_optimizer
+from fedml_tpu.algorithms.split import kl_temperature
+
+Pytree = Any
+
+# message types (module-local space, like the reference's per-algorithm
+# message_define.py files)
+MSG_SNN_TURN = 100
+MSG_SNN_ACTS = 101
+MSG_SNN_GRADS = 102
+MSG_SNN_EPOCH_DONE = 103
+
+MSG_GKT_START = 110
+MSG_GKT_FEATURES = 111
+
+MSG_VFL_STEP = 120
+MSG_VFL_COMPONENT = 121
+MSG_VFL_GRAD = 122
+
+
+# ---------------------------------------------------------------------------
+# SplitNN
+# ---------------------------------------------------------------------------
+
+
+class SplitNNServerActor(ServerManager):
+    """Upper-trunk owner (reference ``split_nn/server.py``): receives
+    activations+labels, answers with the cut gradient, steps its own
+    optimizer, coordinates the ring."""
+
+    def __init__(
+        self,
+        size: int,
+        transport: BaseTransport,
+        server_model,
+        server_vars: Pytree,
+        cfg: ExperimentConfig,
+    ):
+        super().__init__(0, size, transport)
+        self.cfg = cfg
+        self.server_model = server_model
+        self.server_vars = server_vars
+        self.s_opt = make_client_optimizer(cfg.train)
+        self.server_opt_state = self.s_opt.init(server_vars["params"])
+        self.round_idx = 0
+        self._turn = 1  # rank whose epoch is running
+        self.loss_sum = 0.0
+        self.correct_sum = 0.0
+        self.n_sum = 0.0
+        self.metrics_history: list[dict] = []
+        self.done = threading.Event()
+        self.register_message_receive_handler(MSG_SNN_ACTS, self._on_acts)
+        self.register_message_receive_handler(
+            MSG_SNN_EPOCH_DONE, self._on_epoch_done
+        )
+
+        def server_step(s_vars, s_os, acts, yb, wb):
+            """Identical math to SplitNNSim._round's server half: loss and
+            grads w.r.t. (acts, server params), valid-gated update."""
+            sp = s_vars["params"]
+            s_static = {k: v for k, v in s_vars.items() if k != "params"}
+
+            def f(acts, sp):
+                logits = self.server_model.apply(
+                    {**s_static, "params": sp}, acts, train=True
+                )
+                ce = optax.softmax_cross_entropy_with_integer_labels(
+                    logits, yb
+                )
+                loss = jnp.sum(ce * wb) / jnp.maximum(jnp.sum(wb), 1.0)
+                correct = jnp.sum(
+                    (jnp.argmax(logits, -1) == yb).astype(jnp.float32) * wb
+                )
+                return loss, correct
+
+            (loss, correct), (d_acts, sg) = jax.value_and_grad(
+                f, argnums=(0, 1), has_aux=True
+            )(acts, sp)
+            su, new_os = self.s_opt.update(sg, s_os, sp)
+            new_vars = {
+                **s_vars, "params": optax.apply_updates(sp, su)
+            }
+            valid = jnp.sum(wb) > 0
+            sel = lambda a, b: jax.tree.map(
+                lambda p, q: jnp.where(valid, p, q), a, b
+            )
+            return (
+                sel(new_vars, s_vars), sel(new_os, s_os), d_acts,
+                jnp.where(valid, loss, 0.0), correct, jnp.sum(wb),
+            )
+
+        self._server_step = jax.jit(server_step)
+
+    def start_round(self) -> None:
+        self._turn = 1
+        self.send_message(
+            Message(MSG_SNN_TURN, 0, 1, {"round": self.round_idx})
+        )
+
+    def _on_acts(self, msg: Message) -> None:
+        acts = jnp.asarray(msg.get("acts"))
+        yb = jnp.asarray(msg.get("y"))
+        wb = jnp.asarray(msg.get("w"))
+        (self.server_vars, self.server_opt_state, d_acts, loss, correct,
+         wsum) = self._server_step(
+            self.server_vars, self.server_opt_state, acts, yb, wb
+        )
+        self.loss_sum += float(loss)
+        self.correct_sum += float(correct)
+        self.n_sum += float(wsum)
+        self.send_message(
+            Message(
+                MSG_SNN_GRADS, 0, msg.sender,
+                {"d_acts": np.asarray(d_acts)},
+            )
+        )
+
+    def _on_epoch_done(self, msg: Message) -> None:
+        if self._turn < self.size - 1:
+            self._turn += 1
+            self.send_message(
+                Message(
+                    MSG_SNN_TURN, 0, self._turn,
+                    {"round": self.round_idx},
+                )
+            )
+            return
+        # ring complete: book metrics exactly like the sim
+        n = self.size - 1
+        steps = msg.get("steps")
+        self.metrics_history.append(
+            {
+                "train_loss": self.loss_sum / (n * steps),
+                "train_acc": self.correct_sum / max(self.n_sum, 1.0),
+            }
+        )
+        self.loss_sum = self.correct_sum = self.n_sum = 0.0
+        self.round_idx += 1
+        if self.round_idx >= self.cfg.fed.num_rounds:
+            self.done.set()
+            self.finish_all()
+        else:
+            self.start_round()
+
+
+class SplitNNClientActor(ClientManager):
+    """Lower-stack owner (reference ``split_nn/client.py``): forwards its
+    batch through the local stack, ships activations, applies the
+    returned cut gradient via the local vjp."""
+
+    def __init__(
+        self,
+        rank: int,
+        size: int,
+        transport: BaseTransport,
+        client_model,
+        client_vars: Pytree,
+        data: FederatedData,
+        cfg: ExperimentConfig,
+    ):
+        super().__init__(rank, size, transport)
+        self.cfg = cfg
+        self.client_model = client_model
+        self.c_vars = client_vars
+        self.arrays, self.batch_size = arrays_and_batch(data, cfg.data)
+        self.max_n = self.arrays.max_client_samples
+        self.steps = self.max_n // self.batch_size
+        self.c_opt = make_client_optimizer(cfg.train)
+        self.root_key = jax.random.key(cfg.seed)
+        self.client_index = rank - 1
+        self._step = 0
+        self._opt_state = None
+        self._xb = None
+        self._wb = None
+        self.register_message_receive_handler(MSG_SNN_TURN, self._on_turn)
+        self.register_message_receive_handler(MSG_SNN_GRADS, self._on_grads)
+
+        def batch_and_acts(c_vars, ckey, step):
+            """The sim's exact batch order (perm from the round/client key,
+            real-first stable sort), then the lower-stack forward."""
+            idx_row = self.arrays.idx[self.client_index]
+            mask_row = self.arrays.mask[self.client_index]
+            perm = jax.random.permutation(ckey, self.max_n)
+            order = jnp.argsort(1.0 - mask_row[perm], stable=True)
+            take = jax.lax.dynamic_slice_in_dim(
+                perm[order], step * self.batch_size, self.batch_size
+            )
+            b_idx = idx_row[take]
+            wb = mask_row[take]
+            xb = jnp.take(self.arrays.x, b_idx, axis=0)
+            yb = jnp.take(self.arrays.y, b_idx, axis=0)
+            acts = self.client_model.apply(c_vars, xb, train=True)
+            return xb, yb, wb, acts
+
+        def apply_cut_grads(c_vars, c_os, xb, wb, d_acts):
+            """Client-side backward through the cut: vjp at the same
+            point the forward used (chain rule == the sim's joint grad),
+            valid-gated update like the sim."""
+            cp = c_vars["params"]
+            c_static = {k: v for k, v in c_vars.items() if k != "params"}
+            _, vjp_fn = jax.vjp(
+                lambda p: self.client_model.apply(
+                    {**c_static, "params": p}, xb, train=True
+                ),
+                cp,
+            )
+            (cg,) = vjp_fn(d_acts)
+            cu, new_os = self.c_opt.update(cg, c_os, cp)
+            new_vars = {**c_vars, "params": optax.apply_updates(cp, cu)}
+            valid = jnp.sum(wb) > 0
+            sel = lambda a, b: jax.tree.map(
+                lambda p, q: jnp.where(valid, p, q), a, b
+            )
+            return sel(new_vars, c_vars), sel(new_os, c_os)
+
+        self._batch_and_acts = jax.jit(batch_and_acts)
+        self._apply_cut_grads = jax.jit(apply_cut_grads)
+
+    def _on_turn(self, msg: Message) -> None:
+        rkey = R.round_key(self.root_key, jnp.asarray(msg.get("round")))
+        self._ckey = R.client_key(rkey, self.client_index)
+        self._opt_state = self.c_opt.init(self.c_vars["params"])
+        self._step = 0
+        self._send_acts()
+
+    def _send_acts(self) -> None:
+        xb, yb, wb, acts = self._batch_and_acts(
+            self.c_vars, self._ckey, self._step
+        )
+        self._xb, self._wb = xb, wb
+        self.send_message(
+            Message(
+                MSG_SNN_ACTS, self.rank, 0,
+                {
+                    "acts": np.asarray(acts),
+                    "y": np.asarray(yb),
+                    "w": np.asarray(wb),
+                },
+            )
+        )
+
+    def _on_grads(self, msg: Message) -> None:
+        d_acts = jnp.asarray(msg.get("d_acts"))
+        self.c_vars, self._opt_state = self._apply_cut_grads(
+            self.c_vars, self._opt_state, self._xb, self._wb, d_acts
+        )
+        self._step += 1
+        if self._step < self.steps:
+            self._send_acts()
+        else:
+            self.send_message(
+                Message(
+                    MSG_SNN_EPOCH_DONE, self.rank, 0,
+                    {"steps": self.steps},
+                )
+            )
+
+
+# ---------------------------------------------------------------------------
+# FedGKT
+# ---------------------------------------------------------------------------
+
+
+class GKTClientActor(ClientManager):
+    """Edge trainer (reference ``GKTClientTrainer``): local CE(+KD) epochs
+    on the lower stack, then ships extracted feature maps + local logits
+    + labels for its samples (``GKTClientTrainer.py:50``)."""
+
+    def __init__(
+        self,
+        rank: int,
+        size: int,
+        transport: BaseTransport,
+        sim,  # FedGKTSim — the source of truth for the client-phase math
+        client_vars: Pytree,
+    ):
+        super().__init__(rank, size, transport)
+        self.sim = sim
+        self.c_vars = client_vars
+        self.client_index = rank - 1
+        self.register_message_receive_handler(
+            MSG_GKT_START, self._on_start
+        )
+        self._client_phase = jax.jit(sim._client_phase)
+
+        def extract(c_vars):
+            """Per-slot features/logits for this client's padded index
+            row, batched exactly like the server pass batches (row-wise
+            values are batch-invariant: eval-mode forward)."""
+            arrays = self.sim.arrays
+            idx_row = arrays.idx[self.client_index]
+            bs = self.sim.batch_size
+
+            def body(_, s):
+                take = jax.lax.dynamic_slice_in_dim(idx_row, s * bs, bs)
+                xb = jnp.take(arrays.x, take, axis=0)
+                yb = jnp.take(arrays.y, take, axis=0)
+                fb, lb = self.sim._client_apply_eval(c_vars, xb)
+                return None, (fb, lb, yb)
+
+            _, (f, l, y) = jax.lax.scan(
+                body, None, jnp.arange(self.sim.max_n // bs)
+            )
+            flat = lambda a: a.reshape((-1,) + a.shape[2:])
+            return flat(f), flat(l), flat(y)
+
+        self._extract = jax.jit(extract)
+
+    def _on_start(self, msg: Message) -> None:
+        arrays = self.sim.arrays
+        c = self.client_index
+        rkey = R.round_key(self.sim.root_key, jnp.asarray(msg.get("round")))
+        ckey = R.client_key(rkey, c)
+        s_logits = jnp.asarray(msg.get("server_logits"))
+        use_kd = jnp.asarray(msg.get("use_kd"))
+        self.c_vars = self._client_phase(
+            self.c_vars, arrays.idx[c], arrays.mask[c], arrays.x,
+            arrays.y, s_logits, use_kd, ckey,
+        )
+        f, l, y = self._extract(self.c_vars)
+        self.send_message(
+            Message(
+                MSG_GKT_FEATURES, self.rank, 0,
+                {
+                    "features": np.asarray(f),
+                    "logits": np.asarray(l),
+                    "labels": np.asarray(y),
+                    "mask": np.asarray(arrays.mask[c]),
+                },
+            )
+        )
+
+
+class GKTServerActor(ServerManager):
+    """Server trainer (reference ``GKTServerTrainer``): trains the upper
+    trunk on the received feature banks (KD to client logits + CE), then
+    returns per-sample teacher logits. Batch order matches the sim's
+    server pass (same skey-derived perms), so numerics match the compiled
+    FedGKTSim even though features arrive over the wire instead of being
+    recomputed in-program."""
+
+    def __init__(
+        self,
+        size: int,
+        transport: BaseTransport,
+        sim,  # FedGKTSim
+        server_vars: Pytree,
+    ):
+        super().__init__(0, size, transport)
+        self.sim = sim
+        self.server_vars = server_vars
+        self.server_opt_state = sim.s_opt.init(server_vars["params"])
+        self.round_idx = 0
+        self.done = threading.Event()
+        self._banks: dict[int, dict] = {}
+        self.server_logits = jnp.zeros(
+            (sim.n_total, sim.num_classes)
+        )
+        self.register_message_receive_handler(
+            MSG_GKT_FEATURES, self._on_features
+        )
+
+        def server_phase(s_vars, s_os, f_banks, l_banks, y_banks, masks,
+                         round_idx):
+            """The sim's server training re-expressed over received banks:
+            same loss, same per-epoch/client perms (skey), same gating.
+            f_banks: [n, max_n, ...] per-slot features in idx-row order.
+            ``round_idx`` is a traced argument so ONE jit serves every
+            round (no per-round recompiles)."""
+            cfg = self.sim.cfg
+            bs = self.sim.batch_size
+            steps = self.sim.max_n // bs
+            rkey = R.round_key(self.sim.root_key, round_idx)
+            skey = jax.random.fold_in(rkey, 0x5EAF)
+
+            def s_loss_fn(params, static, fb, yb, tb, wb):
+                variables = {**static, "params": params}
+                out, new_vars = self.sim._server_apply_train(variables, fb)
+                kd = kl_temperature(out, tb, self.sim.T, wb)
+                ce = optax.softmax_cross_entropy_with_integer_labels(
+                    out, yb
+                )
+                ce = jnp.sum(ce * wb) / jnp.maximum(jnp.sum(wb), 1.0)
+                return kd + self.sim.alpha * ce, new_vars
+
+            s_grad = jax.value_and_grad(s_loss_fn, has_aux=True)
+
+            def client_pass(carry, inputs):
+                variables, opt_state = carry
+                fbank, lbank, ybank, mask_row, ckey = inputs
+                perm = jax.random.permutation(ckey, self.sim.max_n)
+                order = jnp.argsort(1.0 - mask_row[perm], stable=True)
+                perm = perm[order]
+
+                def step(carry2, s):
+                    variables, opt_state = carry2
+                    take = jax.lax.dynamic_slice_in_dim(perm, s * bs, bs)
+                    fb = jnp.take(fbank, take, axis=0)
+                    tb = jnp.take(lbank, take, axis=0)
+                    yb = jnp.take(ybank, take, axis=0)
+                    wb = mask_row[take]
+                    params = variables["params"]
+                    static = {
+                        k: v for k, v in variables.items()
+                        if k != "params"
+                    }
+                    (_, new_vars), grads = s_grad(
+                        params, static, fb, yb, tb, wb
+                    )
+                    updates, new_os = self.sim.s_opt.update(
+                        grads, opt_state, params
+                    )
+                    new_vars = {
+                        **new_vars,
+                        "params": optax.apply_updates(params, updates),
+                    }
+                    valid = jnp.sum(wb) > 0
+                    sel = lambda a, b: jax.tree.map(
+                        lambda p, q: jnp.where(valid, p, q), a, b
+                    )
+                    return (
+                        sel(new_vars, variables), sel(new_os, opt_state)
+                    ), None
+
+                carry2, _ = jax.lax.scan(
+                    step, (variables, opt_state), jnp.arange(steps)
+                )
+                return carry2, None
+
+            n = f_banks.shape[0]
+
+            def s_epoch(carry, ekey):
+                ckeys_e = jax.vmap(lambda c: jax.random.fold_in(ekey, c))(
+                    jnp.arange(n)
+                )
+                carry, _ = jax.lax.scan(
+                    client_pass, carry,
+                    (f_banks, l_banks, y_banks, masks, ckeys_e),
+                )
+                return carry, None
+
+            ekeys = jax.vmap(lambda e: jax.random.fold_in(skey, e))(
+                jnp.arange(cfg.train.epochs)
+            )
+            (s_vars, s_os), _ = jax.lax.scan(
+                s_epoch, (s_vars, s_os), ekeys
+            )
+
+            # teacher logits bank from the received features (sim step 4)
+            def logits_client(bank, inputs):
+                fbank, mask_row, idx_row = inputs
+
+                def body(bank, s):
+                    take = jax.lax.dynamic_slice_in_dim(
+                        idx_row, s * bs, bs
+                    )
+                    fslot = jax.lax.dynamic_slice_in_dim(
+                        fbank, s * bs, bs
+                    )
+                    wb = jax.lax.dynamic_slice_in_dim(
+                        mask_row, s * bs, bs
+                    )
+                    out = self.sim._server_apply_eval(s_vars, fslot)
+                    safe = jnp.where(
+                        wb > 0, take, self.sim.n_total
+                    ).astype(jnp.int32)
+                    return bank.at[safe].set(out), None
+
+                bank, _ = jax.lax.scan(
+                    body, bank, jnp.arange(steps)
+                )
+                return bank, None
+
+            bank0 = jnp.zeros(
+                (self.sim.n_total + 1, self.sim.num_classes)
+            )
+            bank, _ = jax.lax.scan(
+                logits_client, bank0,
+                (f_banks, masks, self.sim.arrays.idx),
+            )
+            return s_vars, s_os, bank[: self.sim.n_total]
+
+        self._server_phase = jax.jit(server_phase)
+
+    def start_round(self) -> None:
+        host_logits = np.asarray(self.server_logits)
+        self.broadcast(
+            MSG_GKT_START,
+            lambda r: {
+                "round": self.round_idx,
+                "server_logits": host_logits,
+                "use_kd": self.round_idx > 0,
+            },
+        )
+
+    def _on_features(self, msg: Message) -> None:
+        self._banks[msg.sender] = msg.payload
+        if len(self._banks) < self.size - 1:
+            return
+        banks = [self._banks[r] for r in range(1, self.size)]
+        self._banks = {}
+        stack = lambda key: jnp.stack(
+            [jnp.asarray(b[key]) for b in banks]
+        )
+        f_banks, l_banks, y_banks, masks = (
+            stack("features"), stack("logits"), stack("labels"),
+            stack("mask"),
+        )
+        (self.server_vars, self.server_opt_state,
+         self.server_logits) = self._server_phase(
+            self.server_vars, self.server_opt_state,
+            f_banks, l_banks, y_banks, masks,
+            jnp.asarray(self.round_idx, jnp.int32),
+        )
+        self.round_idx += 1
+        if self.round_idx >= self.sim.cfg.fed.num_rounds:
+            self.done.set()
+            self.finish_all()
+        else:
+            self.start_round()
+
+
+# ---------------------------------------------------------------------------
+# Vertical FL
+# ---------------------------------------------------------------------------
+
+
+class VFLGuestActor(ServerManager):
+    """Label owner (reference ``guest_trainer.py``): sums the parties'
+    logit components, computes the common gradient
+    d BCE / d component (identical for every party), trains its own
+    slice, returns the gradient to the hosts."""
+
+    def __init__(
+        self,
+        size: int,
+        transport: BaseTransport,
+        sim,  # VFLSim — source of truth for batching and party math
+        party_vars: Pytree,
+        opt_states,
+        epochs: int,
+    ):
+        super().__init__(0, size, transport)
+        self.sim = sim
+        self.party_vars = party_vars  # party 0 (guest) variables
+        self.opt_states = opt_states
+        self.epochs = epochs
+        self.epoch = 0
+        self.step_idx = 0  # global step counter (sim's state.step)
+        self.losses: list[float] = []
+        self.epoch_losses: list[float] = []
+        self._components: dict[int, np.ndarray] = {}
+        self._perm = None
+        self._pos = 0
+        self.done = threading.Event()
+        self.register_message_receive_handler(
+            MSG_VFL_COMPONENT, self._on_component
+        )
+
+        def guest_step(pv, os_, xb, yb, host_sum):
+            """Guest's half of the sim's joint step: its component is
+            differentiated jointly with the BCE of (its component +
+            received host components); the cotangent of the host sum IS
+            the common gradient the hosts need (sim: autodiff through
+            the sum gives every party that same dL/dtotal)."""
+            lv, dv = pv
+            lo, do = os_
+
+            def loss_fn(lp, dp, host_sum):
+                c = self.sim._party_logit(
+                    ({**lv, "params": lp}, {**dv, "params": dp}), 0, xb,
+                    True,
+                )
+                bce = optax.sigmoid_binary_cross_entropy(
+                    c + host_sum, yb
+                )
+                return jnp.mean(bce)
+
+            loss, (lg, dg, d_host) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1, 2)
+            )(lv["params"], dv["params"], host_sum)
+            lu, new_lo = self.sim.opt.update(lg, lo, lv["params"])
+            du, new_do = self.sim.opt.update(dg, do, dv["params"])
+            new_pv = (
+                {**lv, "params": optax.apply_updates(lv["params"], lu)},
+                {**dv, "params": optax.apply_updates(dv["params"], du)},
+            )
+            return new_pv, (new_lo, new_do), d_host, loss
+
+        self._guest_step = jax.jit(guest_step)
+
+    def start_epoch(self) -> None:
+        n = self.sim.x_train.shape[0]
+        rng = np.random.default_rng(int(self.step_idx))
+        self._perm = rng.permutation(n)
+        self._pos = 0
+        self.epoch_losses = []
+        if n // self.sim.batch_size == 0:
+            # mirror VFLSim.run_epoch exactly: zero full batches means
+            # zero steps and loss 0.0 — no ragged-batch update
+            self._finish_epoch()
+            return
+        self._request_step()
+
+    def _request_step(self) -> None:
+        bs = self.sim.batch_size
+        take = self._perm[self._pos * bs:(self._pos + 1) * bs]
+        self._take = take
+        self.broadcast(
+            MSG_VFL_STEP, lambda r: {"idx": np.asarray(take)}
+        )
+
+    def _on_component(self, msg: Message) -> None:
+        self._components[msg.sender] = msg.get("component")
+        if len(self._components) < self.size - 1:
+            return
+        host_sum = jnp.sum(
+            jnp.stack(
+                [
+                    jnp.asarray(self._components[r])
+                    for r in range(1, self.size)
+                ]
+            ),
+            axis=0,
+        )
+        self._components = {}
+        xb = self.sim._slice(
+            self.sim.x_train[self._take], 0
+        )
+        yb = self.sim.y_train[self._take]
+        (self.party_vars, self.opt_states, d_host,
+         loss) = self._guest_step(
+            self.party_vars, self.opt_states, xb, yb, host_sum
+        )
+        self.epoch_losses.append(float(loss))
+        self.broadcast(
+            MSG_VFL_GRAD, lambda r: {"grad": np.asarray(d_host)}
+        )
+        self.step_idx += 1
+        self._pos += 1
+        if self._pos < len(self._perm) // self.sim.batch_size:
+            self._request_step()
+            return
+        self._finish_epoch()
+
+    def _finish_epoch(self) -> None:
+        self.losses.append(
+            float(np.mean(self.epoch_losses)) if self.epoch_losses
+            else 0.0
+        )
+        self.epoch += 1
+        if self.epoch >= self.epochs:
+            self.done.set()
+            self.finish_all()
+        else:
+            self.start_epoch()
+
+
+class VFLHostActor(ClientManager):
+    """Feature-slice owner without labels (reference
+    ``party_models.py``): answers batch requests with its logit
+    component, applies the guest's common gradient via local vjp."""
+
+    def __init__(
+        self,
+        rank: int,
+        size: int,
+        transport: BaseTransport,
+        sim,  # VFLSim
+        party_vars: Pytree,
+        opt_states,
+    ):
+        super().__init__(rank, size, transport)
+        self.sim = sim
+        self.party = rank  # sim party index (guest is 0)
+        self.party_vars = party_vars
+        self.opt_states = opt_states
+        self._xb = None
+        self.register_message_receive_handler(
+            MSG_VFL_STEP, self._on_step
+        )
+        self.register_message_receive_handler(
+            MSG_VFL_GRAD, self._on_grad
+        )
+
+        def component(pv, xb):
+            return self.sim._party_logit(pv, self.party, xb, True)
+
+        def apply_grad(pv, os_, xb, d_comp):
+            lv, dv = pv
+            lo, do = os_
+            _, vjp_fn = jax.vjp(
+                lambda lp, dp: self.sim._party_logit(
+                    ({**lv, "params": lp}, {**dv, "params": dp}),
+                    self.party, xb, True,
+                ),
+                lv["params"], dv["params"],
+            )
+            lg, dg = vjp_fn(d_comp)
+            lu, new_lo = self.sim.opt.update(lg, lo, lv["params"])
+            du, new_do = self.sim.opt.update(dg, do, dv["params"])
+            new_pv = (
+                {**lv, "params": optax.apply_updates(lv["params"], lu)},
+                {**dv, "params": optax.apply_updates(dv["params"], du)},
+            )
+            return new_pv, (new_lo, new_do)
+
+        self._component = jax.jit(component)
+        self._apply_grad = jax.jit(apply_grad)
+
+    def _on_step(self, msg: Message) -> None:
+        take = np.asarray(msg.get("idx"))
+        self._xb = self.sim._slice(self.sim.x_train[take], self.party)
+        comp = self._component(self.party_vars, self._xb)
+        self.send_message(
+            Message(
+                MSG_VFL_COMPONENT, self.rank, 0,
+                {"component": np.asarray(comp)},
+            )
+        )
+
+    def _on_grad(self, msg: Message) -> None:
+        d_comp = jnp.asarray(msg.get("grad"))
+        self.party_vars, self.opt_states = self._apply_grad(
+            self.party_vars, self.opt_states, self._xb, d_comp
+        )
+
+
+# ---------------------------------------------------------------------------
+# Launchers: wire an actor set over a backend and run to completion
+# ---------------------------------------------------------------------------
+
+
+def _run_actors(server: Manager, clients: Sequence[Manager],
+                kickoff: Callable[[], None], timeout: float = 600.0):
+    threads = [
+        threading.Thread(target=c.run, daemon=True) for c in clients
+    ]
+    for t in threads:
+        t.start()
+    server.transport.start()
+    kickoff()
+    server.run()
+    for t in threads:
+        t.join(timeout=timeout)
+
+
+def run_splitnn_distributed(
+    client_model, server_model, data: FederatedData,
+    cfg: ExperimentConfig, transports: Sequence[BaseTransport],
+    init_state,
+):
+    """Run SplitNN actors (1 server + N clients) over started-or-startable
+    ``transports`` (rank order), starting from a ``SplitNNSim`` init
+    state; returns (server actor, final client vars list)."""
+    size = len(transports)
+    server = SplitNNServerActor(
+        size, transports[0], server_model,
+        init_state.server_vars, cfg,
+    )
+    clients = [
+        SplitNNClientActor(
+            r, size, transports[r], client_model,
+            jax.tree.map(lambda s: s[r - 1], init_state.client_stack),
+            data, cfg,
+        )
+        for r in range(1, size)
+    ]
+    for t in transports[1:]:
+        t.start()
+    _run_actors(server, clients, server.start_round)
+    return server, [c.c_vars for c in clients]
+
+
+def run_gkt_distributed(
+    sim, transports: Sequence[BaseTransport], init_state
+):
+    """Run FedGKT actors from a ``FedGKTSim`` (used for its jitted phase
+    math and config) and its init state; returns the server actor."""
+    size = len(transports)
+    server = GKTServerActor(
+        size, transports[0], sim, init_state.server_vars
+    )
+    clients = [
+        GKTClientActor(
+            r, size, transports[r], sim,
+            jax.tree.map(lambda s: s[r - 1], init_state.client_stack),
+        )
+        for r in range(1, size)
+    ]
+    for t in transports[1:]:
+        t.start()
+    _run_actors(server, clients, server.start_round)
+    return server, [c.c_vars for c in clients]
+
+
+def run_vfl_distributed(
+    sim, transports: Sequence[BaseTransport], init_state, epochs: int
+):
+    """Run vertical-FL actors from a ``VFLSim`` init state: guest =
+    party 0 (rank 0), hosts = parties 1.. (ranks 1..). Returns
+    (guest actor, host actors)."""
+    size = len(transports)
+    guest = VFLGuestActor(
+        size, transports[0], sim,
+        init_state.party_vars[0], init_state.opt_states[0], epochs,
+    )
+    hosts = [
+        VFLHostActor(
+            r, size, transports[r], sim,
+            init_state.party_vars[r], init_state.opt_states[r],
+        )
+        for r in range(1, size)
+    ]
+    for t in transports[1:]:
+        t.start()
+    _run_actors(guest, hosts, guest.start_epoch)
+    return guest, hosts

@@ -68,18 +68,18 @@ class Message:
         )
         return Message(self.msg_type, self.sender, self.receiver, payload)
 
-    def encode(self) -> bytes:
-        """Wire format: bulk tensors ride the native C++ tensor-frame codec
-        (:mod:`fedml_tpu.native.codec` — multithreaded gather memcpy, CRC);
-        everything else (structure + scalars) is pickled. Replaces the
-        reference's whole-payload pickle (``mpi_send_thread.py:22-27``).
-        """
-        from fedml_tpu.native.codec import TensorCodec
+    def encode_parts(self) -> tuple[bytes, bytes]:
+        """Split encoding: ``(meta, tensor_frame)``. Bulk tensors ride the
+        native C++ tensor-frame codec (:mod:`fedml_tpu.native.codec` —
+        multithreaded gather memcpy, CRC); everything else (structure +
+        scalars) is pickled into ``meta`` with :class:`_TensorRef`
+        placeholders. Transports choose the region order on the wire:
+        :meth:`encode` puts meta first; the TRPC-class transport ships the
+        tensor frame first (tensor-native framing)."""
+        from fedml_tpu.native.codec import TensorCodec, codec_supports
 
         host = self.host_copy()
         arrays: list[np.ndarray] = []
-
-        from fedml_tpu.native.codec import codec_supports
 
         def strip(v):
             if (
@@ -97,6 +97,31 @@ class Message:
             protocol=5,
         )
         frame = TensorCodec().pack(arrays) if arrays else b""
+        return meta, frame
+
+    @staticmethod
+    def from_parts(meta: bytes, frame) -> "Message":
+        """Inverse of :meth:`encode_parts`. ``frame`` may be any buffer
+        (bytes/bytearray/memoryview) — the codec reads it zero-copy and
+        the arrays are copied out so they don't pin the wire buffer."""
+        msg = pickle.loads(meta)
+        assert isinstance(msg, Message)
+        if frame:
+            from fedml_tpu.native.codec import TensorCodec
+
+            arrays = [a.copy() for a in TensorCodec().unpack(frame)]
+            msg.payload = jax.tree.map(
+                lambda v: arrays[v.idx] if isinstance(v, _TensorRef) else v,
+                msg.payload,
+                is_leaf=lambda v: isinstance(v, _TensorRef),
+            )
+        return msg
+
+    def encode(self) -> bytes:
+        """One-buffer wire format: ``MAGIC || meta_len || meta || frame``.
+        Replaces the reference's whole-payload pickle
+        (``mpi_send_thread.py:22-27``)."""
+        meta, frame = self.encode_parts()
         return _WIRE_MAGIC + _HDR.pack(len(meta)) + meta + frame
 
     @staticmethod
@@ -108,18 +133,6 @@ class Message:
         off = len(_WIRE_MAGIC)
         (meta_len,) = _HDR.unpack_from(data, off)
         off += _HDR.size
-        msg = pickle.loads(data[off:off + meta_len])
-        assert isinstance(msg, Message)
-        frame = data[off + meta_len:]
-        if frame:
-            from fedml_tpu.native.codec import TensorCodec
-
-            # copy: consumers own (writable) arrays that don't pin the
-            # whole wire frame alive, matching the old pickle semantics
-            arrays = [a.copy() for a in TensorCodec().unpack(frame)]
-            msg.payload = jax.tree.map(
-                lambda v: arrays[v.idx] if isinstance(v, _TensorRef) else v,
-                msg.payload,
-                is_leaf=lambda v: isinstance(v, _TensorRef),
-            )
-        return msg
+        return Message.from_parts(
+            data[off:off + meta_len], data[off + meta_len:]
+        )

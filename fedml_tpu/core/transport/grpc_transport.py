@@ -80,7 +80,12 @@ class GrpcTransport(BaseTransport):
     def stop(self) -> None:
         super().stop()
         if self._server is not None:
-            self._server.stop(grace=0.5)
+            # stop() returns an event; WAIT for in-flight handlers to
+            # drain before closing client channels — a handler may be
+            # mid-send (replies run on server pool threads), and closing
+            # its channel under it raises _InactiveRpcError("Channel
+            # closed!") on that thread.
+            self._server.stop(grace=2.0).wait(timeout=5)
         for ch in self._channels.values():
             ch.close()
         self._channels.clear()

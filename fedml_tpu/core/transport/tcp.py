@@ -93,8 +93,12 @@ class TcpTransport(BaseTransport):
 
     def send_message(self, msg: Message) -> None:
         data = msg.encode()
-        rank = msg.receiver
-        frame = _HDR.pack(len(data)) + data
+        self._send_wire(msg.receiver, _HDR.pack(len(data)) + data)
+
+    def _send_wire(self, rank: int, frame: bytes) -> None:
+        """Ship pre-framed bytes to ``rank`` over the pooled connection
+        (one dead-socket retry). Subclasses with their own wire format
+        (tensor_rpc) reuse this for the connection machinery."""
         with self._rank_lock(rank):
             with self._lock:
                 sock = self._conns.get(rank)

@@ -6,29 +6,77 @@ pickling the tensor bytes into the control stream, and the file carries an
 inline message-size micro-benchmark (``:147-209``, grep-able
 "--Benchmark" lines).
 
-TPU-native equivalent: the :class:`TcpTransport` socket machinery with a
-wire format that puts the native C++ tensor frame FIRST and the (small)
-pickled envelope after it, so the receiving side can hand the tensor
-region to the zero-copy codec without scanning past python bytes — plus
-:func:`benchmark_transport`, the reference's latency micro-benchmark as a
-utility usable against ANY BaseTransport.
+TPU-native equivalent: :class:`TcpTransport`'s socket machinery with a
+TENSOR-FIRST wire format. Where TcpTransport ships one opaque
+``Message.encode()`` buffer (meta pickle first, tensor frame after), this
+transport frames the two regions separately::
+
+    u64 frame_len || tensor frame || u64 meta_len || meta pickle
+
+so the receiver streams the (large) tensor region straight into its own
+buffer and hands it to the zero-copy native codec (``native/codec.cpp``)
+without concatenating it behind python pickle bytes, then reads the
+(small) envelope. That is TensorPipe's split: tensors on the payload
+channel, control data on the descriptor channel. Also here:
+:func:`benchmark_transport`, the reference's latency micro-benchmark as
+a utility usable against ANY BaseTransport.
 """
 
 from __future__ import annotations
 
+import socket
+import struct
 import time
 
 import numpy as np
 
 from fedml_tpu.core.message import KEY_MODEL_PARAMS, Message
-from fedml_tpu.core.transport.tcp import TcpTransport
+from fedml_tpu.core.transport.tcp import TcpTransport, _recv_exact
+
+_HDR = struct.Struct(">Q")
+
+
+def _recv_into(sock: socket.socket, buf: memoryview) -> bool:
+    """Fill ``buf`` exactly from the socket (no intermediate concats —
+    the point of tensor-first framing is that the bulk region lands in
+    one preallocated buffer the codec can scan in place)."""
+    while buf:
+        n = sock.recv_into(buf)
+        if n == 0:
+            return False
+        buf = buf[n:]
+    return True
 
 
 class TensorRpcTransport(TcpTransport):
-    """TCP + tensor-first framing. Functionally identical to TcpTransport
-    (both ride the native codec through ``Message.encode``); kept as a
-    named backend for parity with the reference's TRPC option and as the
-    attachment point for the micro-benchmark."""
+    """TCP with tensor-first framing (see module docstring)."""
+
+    def send_message(self, msg: Message) -> None:
+        meta, frame = msg.encode_parts()
+        rank = msg.receiver
+        wire = (
+            _HDR.pack(len(frame)) + frame + _HDR.pack(len(meta)) + meta
+        )
+        self._send_wire(rank, wire)
+
+    def _read_loop(self, conn: socket.socket) -> None:
+        with conn:
+            while not self._stopped.is_set():
+                hdr = _recv_exact(conn, _HDR.size)
+                if hdr is None:
+                    return
+                (frame_len,) = _HDR.unpack(hdr)
+                frame = bytearray(frame_len)
+                if frame_len and not _recv_into(conn, memoryview(frame)):
+                    return
+                hdr = _recv_exact(conn, _HDR.size)
+                if hdr is None:
+                    return
+                (meta_len,) = _HDR.unpack(hdr)
+                meta = _recv_exact(conn, meta_len)
+                if meta is None:
+                    return
+                self.deliver(Message.from_parts(meta, frame))
 
 
 def benchmark_transport(

@@ -70,9 +70,10 @@ def load_imagenet(
     ``client_number=1000``: one class per client; ``client_number=100``:
     10 consecutive classes per client (reference
     ``load_partition_data_ImageNet:235-243``). Works on any ImageFolder
-    tree (class count need not be 1000; classes are distributed evenly and
-    ``classes % clients`` must be 0). ``client_range=(lo, hi)`` decodes
-    only those clients' training images (per-shard loading)."""
+    tree (class count need not be 1000; classes are distributed evenly,
+    remainder classes dealt one each to the first clients).
+    ``client_range=(lo, hi)`` decodes only those clients' training
+    images (per-shard loading)."""
     train_dir = os.path.join(data_dir, "train")
     val_dir = os.path.join(data_dir, "val")
     if not os.path.isdir(train_dir):
@@ -82,11 +83,18 @@ def load_imagenet(
         )
     classes = [c for c, _ in _iter_image_folder(train_dir)]
     n_classes = len(classes)
-    assert n_classes % client_number == 0, (n_classes, client_number)
-    per_client = n_classes // client_number
-    class_to_client = {
-        c: i // per_client for i, c in enumerate(classes)
-    }
+    if n_classes < client_number:
+        raise ValueError(
+            f"{n_classes} classes cannot be dealt to {client_number} "
+            "clients (need at least one class per client)"
+        )
+    # even dealing with remainder: client i gets classes
+    # [bounds[i], bounds[i+1]) — sizes differ by at most one
+    base, rem = divmod(n_classes, client_number)
+    sizes = np.full(client_number, base, np.int64)
+    sizes[:rem] += 1
+    class_client = np.repeat(np.arange(client_number), sizes)
+    class_to_client = {c: int(class_client[i]) for i, c in enumerate(classes)}
     lo, hi = client_range or (0, client_number)
 
     xs, ys, tr_map = [], [], {i: [] for i in range(client_number)}
@@ -124,13 +132,17 @@ def load_imagenet(
     x_te = np.stack(txs) if txs else x_tr[:1]
     y_te = np.asarray(tys, np.int32) if tys else y_tr[:1]
     # per-client test = the client's own classes (reference gives each
-    # client its local loader over its dataidxs)
-    te_map = {}
-    for i in range(client_number):
-        own = set(range(i * per_client, (i + 1) * per_client))
-        te_map[i] = np.asarray(
-            [j for j, yy in enumerate(y_te) if int(yy) in own], np.int64
-        )
+    # client its local loader over its dataidxs). Vectorized: one stable
+    # argsort of each val image's owning client instead of a
+    # clients x val-set python scan (50M iterations at 1000 x 50k).
+    owner = class_client[np.clip(np.asarray(y_te), 0, n_classes - 1)]
+    order = np.argsort(owner, kind="stable")
+    split_at = np.searchsorted(owner[order], np.arange(client_number))
+    split_bounds = np.append(split_at, len(order))
+    te_map = {
+        i: order[split_bounds[i]:split_bounds[i + 1]].astype(np.int64)
+        for i in range(client_number)
+    }
     return FederatedData(
         x_tr, y_tr, x_te, y_te, tr_map, te_map, n_classes
     )

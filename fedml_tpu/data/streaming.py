@@ -28,9 +28,18 @@ def _kmeans(x: np.ndarray, k: int, iters: int = 20, seed: int = 0):
     rng = np.random.default_rng(seed)
     centers = x[rng.choice(len(x), k, replace=False)]
     assign = np.zeros(len(x), np.int64)
+    # distances computed in row chunks: the full [N, k, d] broadcast is
+    # ~N*k*d*8 bytes (tens of GB at real SUSY scale); chunking keeps the
+    # working set ~chunk*k*d while the expansion
+    # ||x-c||^2 = ||x||^2 - 2 x.c + ||c||^2 does it with one matmul
+    chunk = max(1, 2_000_000 // max(k, 1))
     for _ in range(iters):
-        d = ((x[:, None, :] - centers[None]) ** 2).sum(-1)
-        new_assign = d.argmin(1)
+        c_sq = (centers**2).sum(-1)
+        new_assign = np.empty(len(x), np.int64)
+        for lo in range(0, len(x), chunk):
+            xb = x[lo:lo + chunk]
+            d = (xb**2).sum(-1, keepdims=True) - 2.0 * (xb @ centers.T)
+            new_assign[lo:lo + chunk] = (d + c_sq).argmin(1)
         if (new_assign == assign).all():
             break
         assign = new_assign

@@ -81,8 +81,19 @@ def _resolve_padding(
                 total = max((o - 1) * s + eff_k - i, 0)
                 out.append((total // 2, total - total // 2))
             return tuple(out)
-        raise ValueError(f"unknown padding {padding!r}")
-    return tuple((int(lo), int(hi)) for lo, hi in padding)
+        raise ValueError(
+            f"unknown padding {padding!r} (supported: 'SAME', 'VALID', "
+            "int, per-dim ints, or explicit (lo, hi) pairs; nn.Conv's "
+            "'CIRCULAR' is not implemented here)"
+        )
+    # nn.Conv also accepts a single int or a per-dimension sequence of
+    # ints; normalize them to (lo, hi) pairs to keep the drop-in contract
+    if isinstance(padding, int):
+        return tuple((padding, padding) for _ in in_spatial)
+    return tuple(
+        (int(p), int(p)) if isinstance(p, int) else (int(p[0]), int(p[1]))
+        for p in padding
+    )
 
 
 def _conv_transpose_pads(k, s, padding):

@@ -50,14 +50,41 @@ class RoundCheckpointer:
 
     def restore_or(self, init_state: Any) -> tuple[Any, int]:
         """Return (state, next_round): the restored latest checkpoint if one
-        exists, else ``(init_state, 0)``."""
+        exists, else ``(init_state, 0)``.
+
+        Checkpoints written by pre-``Conv2D`` builds of this repo carry
+        flax auto-scopes named ``Conv_N``/``ConvTranspose_N`` (and
+        auto-numbered ``Dense_N`` heads) where current trees say
+        ``Conv2D_N``/``ConvTranspose2D_N``/named heads; such checkpoints
+        are migrated on restore by :func:`_migrate_scopes` instead of
+        failing the structure match."""
         step = self._mgr.latest_step()
         if step is None:
             return init_state, 0
         template = _to_savable(init_state)
-        restored = self._mgr.restore(
-            step, args=ocp.args.StandardRestore(template)
-        )
+        try:
+            restored = self._mgr.restore(
+                step, args=ocp.args.StandardRestore(template)
+            )
+        except Exception as err:
+            # structure mismatch (e.g. legacy scope names): raw-restore
+            # and remap keys against the template. Migration is strict
+            # (unique shape matches only) and re-raises the ORIGINAL
+            # error when it cannot resolve, so a wrong-experiment or
+            # corrupted checkpoint still fails loudly instead of loading
+            # cross-assigned weights.
+            try:
+                raw = self._mgr.restore(step)
+                restored = _migrate_scopes(template, raw)
+            except Exception:
+                raise err
+            import warnings
+
+            warnings.warn(
+                f"checkpoint at step {step} used legacy scope names; "
+                "restored via scope migration",
+                stacklevel=2,
+            )
         return _from_savable(init_state, restored), step + 1
 
     def close(self):
@@ -73,6 +100,66 @@ def _to_savable(state: Any):
     if isinstance(state, (list, tuple)):
         return {f"__{i}": _to_savable(v) for i, v in enumerate(state)}
     return np.asarray(jax.device_get(state))
+
+
+def _leaf_shapes(t) -> list[tuple]:
+    return [tuple(np.shape(leaf)) for leaf in jax.tree.leaves(t)]
+
+
+def _migrate_scopes(template: Any, blob: Any):
+    """Remap a saved nested dict onto the template's key structure.
+
+    Per dict level: exact key matches first; then the deterministic
+    module renames (``Conv2D_N`` <- ``Conv_N``, ``ConvTranspose2D_N`` <-
+    ``ConvTranspose_N``); finally, a leftover template key is paired
+    with a leftover blob key only when its leaf-shape signature matches
+    UNIQUELY (renamed heads like ``head``/``fc1`` vs legacy ``Dense_N``).
+    Raises ``KeyError`` when a key cannot be resolved or the shape match
+    is ambiguous — never guesses by order."""
+    if not isinstance(template, dict):
+        return blob
+    if not isinstance(blob, dict):
+        raise KeyError(f"checkpoint structure mismatch at {template!r}")
+    out, used = {}, set()
+    unresolved = []
+    for k in template:
+        if k in blob:
+            out[k] = k
+            used.add(k)
+            continue
+        legacy = (
+            k.replace("Conv2D", "Conv")
+            if "ConvTranspose2D" not in k
+            else k.replace("ConvTranspose2D", "ConvTranspose")
+        )
+        if legacy != k and legacy in blob and legacy not in used:
+            out[k] = legacy
+            used.add(legacy)
+        else:
+            unresolved.append(k)
+    spare = [k for k in blob if k not in used]
+    for k in unresolved:
+        matches = [
+            b
+            for b in spare
+            if _leaf_shapes(template[k]) == _leaf_shapes(blob[b])
+        ]
+        if not matches:
+            raise KeyError(
+                f"cannot migrate checkpoint scope {k!r}; "
+                f"unmatched saved scopes: {spare}"
+            )
+        if len(matches) > 1:
+            # two spare scopes share the leaf signature: assigning by
+            # order could silently cross-load weights — refuse
+            raise KeyError(
+                f"ambiguous checkpoint migration for scope {k!r}: "
+                f"{matches} all match its leaf shapes"
+            )
+        out[k] = matches[0]
+        spare.remove(matches[0])
+    return {k: _migrate_scopes(template[k], blob[src])
+            for k, src in out.items()}
 
 
 def _from_savable(template: Any, blob: Any):

@@ -282,3 +282,65 @@ def test_distributed_fedavg_pubsub_blob_matches_loopback():
     for a, b in zip(jax.tree.leaves(v_pubsub), jax.tree.leaves(v_loop)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=1e-6, rtol=1e-6)
+
+
+def test_distributed_fedopt_loopback_matches_sim():
+    """Server-rule composition over the actor runtime: FedOpt (adam
+    pseudo-gradient server) through loopback actors == the compiled
+    FedAvgSim with the same FedConfig — the aggregation goes through the
+    SHARED server_update, so adaptive server optimizers, FedNova, and
+    robust rules all ride the transport zoo (reference
+    fedopt/FedOptAggregator.py over MPI)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fedml_tpu.algorithms.fedavg import FedAvgSim
+
+    cfg = ExperimentConfig(
+        data=DataConfig(dataset="fake_mnist", num_clients=3, batch_size=32,
+                        seed=0),
+        model=ModelConfig(name="lr", num_classes=10,
+                          input_shape=(28, 28, 1)),
+        train=TrainConfig(lr=0.1, epochs=1),
+        fed=FedConfig(num_rounds=2, clients_per_round=3, eval_every=5,
+                      server_optimizer="adam", server_lr=0.05),
+        seed=0,
+    )
+    data = load_dataset(cfg.data)
+    model = create_model(cfg.model)
+    sim = FedAvgSim(model, data, cfg)
+    sim_state = sim.init()
+    init_vars = jax.tree.map(jnp.copy, sim_state.variables)
+    for _ in range(cfg.fed.num_rounds):
+        sim_state, _ = sim.run_round(sim_state)
+
+    hub = LoopbackHub()
+    size = 4
+    arrays = data.to_arrays(pad_multiple=cfg.data.batch_size)
+    server = FedAvgServerActor(
+        size, hub.create(0), model, cfg, num_clients=3,
+        initial_variables=init_vars,
+        steps_per_epoch=arrays.max_client_samples // cfg.data.batch_size,
+    )
+    clients = [
+        FedAvgClientActor(r, size, hub.create(r), model, data, cfg)
+        for r in range(1, size)
+    ]
+    threads = [
+        threading.Thread(target=c.run, daemon=True) for c in clients
+    ]
+    for t in threads:
+        t.start()
+    server.start_round()
+    server.run()
+    assert server.done.wait(timeout=30)
+    for t in threads:
+        t.join(timeout=10)
+
+    for a, b in zip(
+        jax.tree.leaves(server.variables),
+        jax.tree.leaves(sim_state.variables),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-6
+        )

@@ -1,0 +1,253 @@
+"""Split-compute algorithms across a REAL transport boundary must match
+their compiled sims (verdict: the split family previously never crossed
+a process/trust boundary; reference ships activations/features/logit
+components over its comm backends)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.algorithms.split import FedGKTSim, SplitNNSim, VFLSim
+from fedml_tpu.algorithms.split_actors import (
+    run_gkt_distributed,
+    run_splitnn_distributed,
+    run_vfl_distributed,
+)
+from fedml_tpu.config import (
+    DataConfig,
+    ExperimentConfig,
+    FedConfig,
+    ModelConfig,
+    TrainConfig,
+)
+from fedml_tpu.core.manager import create_transport
+from fedml_tpu.core.transport.loopback import LoopbackHub
+from fedml_tpu.data.loaders import make_fake_image_dataset
+from fedml_tpu.models.gkt import (
+    GKTClientResNet,
+    GKTServerResNet,
+    SplitClientNet,
+    SplitServerNet,
+    VFLDenseModel,
+    VFLLocalModel,
+)
+
+
+def _transports(backend: str, size: int, base_port: int):
+    if backend == "loopback":
+        hub = LoopbackHub()
+        return [hub.create(r) for r in range(size)]
+    ip = {r: ("127.0.0.1", base_port + r) for r in range(size)}
+    return [
+        create_transport(backend, r, ip_config=ip) for r in range(size)
+    ]
+
+
+def _close(a, b, rtol=2e-5, atol=1e-6):
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_allclose(
+            np.asarray(x), np.asarray(y), rtol=rtol, atol=atol
+        )
+
+
+def _tiny_cfg(num_clients=3, rounds=2):
+    return ExperimentConfig(
+        data=DataConfig(
+            dataset="fake_mnist", num_clients=num_clients,
+            partition_method="homo", batch_size=8, seed=0,
+        ),
+        model=ModelConfig(name="cnn", num_classes=10,
+                          input_shape=(28, 28, 1)),
+        train=TrainConfig(lr=0.05, epochs=1),
+        fed=FedConfig(num_rounds=rounds, clients_per_round=num_clients),
+        seed=0,
+    )
+
+
+@pytest.mark.parametrize("backend,port", [("loopback", 0),
+                                          ("grpc", 29760)])
+def test_splitnn_actors_match_sim(backend, port):
+    """Activations/cut-gradients over Messages == joint-autodiff sim:
+    server weights, every client's lower stack, and train metrics."""
+    cfg = _tiny_cfg()
+    data = make_fake_image_dataset("mnist", cfg.data, n_train=72,
+                                   n_test=24)
+    client_model = SplitClientNet(features=(8, 16))
+    server_model = SplitServerNet(num_classes=10, hidden=32)
+    sim = SplitNNSim(client_model, server_model, data, cfg)
+    state0 = sim.init()
+    # the sim round donates its input; keep an undeleted copy for actors
+    actor_state0 = jax.tree.map(jnp.copy, state0)
+    state = state0
+    sim_metrics = []
+    for _ in range(cfg.fed.num_rounds):
+        state, m = sim.run_round(state)
+        sim_metrics.append({k: float(v) for k, v in m.items()})
+
+    transports = _transports(backend, cfg.data.num_clients + 1, port)
+    server, client_vars = run_splitnn_distributed(
+        client_model, server_model, data, cfg, transports, actor_state0
+    )
+    assert server.done.is_set()
+    _close(server.server_vars, state.server_vars)
+    for i, cv in enumerate(client_vars):
+        _close(cv, jax.tree.map(lambda s: s[i], state.client_stack))
+    for got, want in zip(server.metrics_history, sim_metrics):
+        assert abs(got["train_loss"] - want["train_loss"]) < 1e-4
+        assert abs(got["train_acc"] - want["train_acc"]) < 1e-5
+
+
+@pytest.mark.parametrize("backend,port", [("loopback", 0),
+                                          pytest.param("grpc", 29770,
+                                                       marks=pytest.mark.slow)])
+def test_vfl_actors_match_sim(backend, port):
+    """Host logit components + guest common gradient over Messages ==
+    the sim's joint step (sum-of-components BCE autodiff)."""
+    rng = np.random.default_rng(0)
+    n, d = 96, 20
+    w = rng.normal(size=(d,))
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = (x @ w > 0).astype(np.float32)
+    cfg = ExperimentConfig(
+        data=DataConfig(batch_size=16),
+        train=TrainConfig(lr=0.1, optimizer="sgd", epochs=1),
+        seed=0,
+    )
+    party_models = [
+        (VFLLocalModel(out_dim=8, hidden=16), VFLDenseModel()),
+        (VFLLocalModel(out_dim=8, hidden=16), VFLDenseModel()),
+        (VFLLocalModel(out_dim=4, hidden=8), VFLDenseModel()),
+    ]
+    splits = [(0, 8), (8, 14), (14, 20)]
+    sim = VFLSim(party_models, splits, x, y, x[:16], y[:16], cfg)
+    state0 = sim.init()
+    actor_state0 = jax.tree.map(jnp.copy, state0)
+    epochs = 2
+    state = state0
+    sim_losses = []
+    for _ in range(epochs):
+        state, loss = sim.run_epoch(state)
+        sim_losses.append(loss)
+
+    transports = _transports(backend, len(party_models), port)
+    guest, hosts = run_vfl_distributed(sim, transports, actor_state0, epochs)
+    assert guest.done.is_set()
+    _close((guest.party_vars, guest.opt_states),
+           (state.party_vars[0], state.opt_states[0]))
+    for h in hosts:
+        _close((h.party_vars, h.opt_states),
+               (state.party_vars[h.party], state.opt_states[h.party]))
+    for got, want in zip(guest.losses, sim_losses):
+        assert abs(got - want) < 1e-5, (guest.losses, sim_losses)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("backend,port", [("loopback", 0),
+                                          ("grpc", 29780)])
+def test_gkt_actors_match_sim(backend, port):
+    """Feature maps/logits over Messages (the reference protocol,
+    GKTClientTrainer.py:50) vs the compiled sim that recomputes features
+    in-program.
+
+    Two-part pin: (1) exact-class — the actor server phase fed banks
+    extracted from the sim's own post-phase-1 client stack reproduces
+    the sim's server weights and teacher-logit bank to f32 round-off
+    (features are batch-invariant; the sums are the same program
+    modulo compile instance); (2) chaos envelope — the
+    full actor run tracks the sim within the amplified f32 divergence
+    of vmapped-vs-unbatched BN client training (client phase ~4e-5 per
+    round, amplified through ~12 KD server steps; same class as the
+    scan-unroll chaos calibration in tests/test_cohort_conv.py)."""
+    from fedml_tpu.algorithms.split_actors import GKTServerActor
+
+    cfg = _tiny_cfg(num_clients=2, rounds=2)
+    data = make_fake_image_dataset("mnist", cfg.data, n_train=48,
+                                   n_test=16)
+    sim = FedGKTSim(
+        GKTClientResNet(num_classes=10, num_blocks=1, width=8),
+        GKTServerResNet(num_classes=10, blocks_per_stage=(1, 1),
+                        widths=(16, 32)),
+        data, cfg, temperature=3.0, alpha=1.0,
+    )
+    state0 = sim.init()
+    actor_state0 = jax.tree.map(jnp.copy, state0)
+    bitwise_sv = jax.tree.map(jnp.copy, state0.server_vars)
+    state = state0
+    s1 = None
+    for r in range(cfg.fed.num_rounds):
+        state, _ = sim.run_round(state)
+        if r == 0:
+            # round 2's donation deletes this state's buffers; copy now
+            s1 = jax.tree.map(jnp.copy, state)
+
+    # (1) bitwise server-phase equality on round-0 banks from the sim's
+    # post-phase-1 client stack
+    srv = GKTServerActor(
+        cfg.data.num_clients + 1, LoopbackHub().create(0), sim,
+        bitwise_sv,
+    )
+    bs = sim.batch_size
+    banks = []
+    for c in range(cfg.data.num_clients):
+        cv = jax.tree.map(lambda s: s[c], s1.client_stack)
+        idx_row = sim.arrays.idx[c]
+        fs, ls, ys = [], [], []
+        for st in range(sim.max_n // bs):
+            take = idx_row[st * bs:(st + 1) * bs]
+            fb, lb = sim._client_apply_eval(
+                cv, jnp.take(sim.arrays.x, take, axis=0)
+            )
+            fs.append(fb)
+            ls.append(lb)
+            ys.append(jnp.take(sim.arrays.y, take, axis=0))
+        banks.append((jnp.concatenate(fs), jnp.concatenate(ls),
+                      jnp.concatenate(ys)))
+    sv, _, bank = srv._server_phase(
+        bitwise_sv, srv.server_opt_state,
+        jnp.stack([b[0] for b in banks]),
+        jnp.stack([b[1] for b in banks]),
+        jnp.stack([b[2] for b in banks]),
+        jnp.stack([sim.arrays.mask[c]
+                   for c in range(cfg.data.num_clients)]),
+        jnp.asarray(0, jnp.int32),
+    )
+    # f32 round-off class: only compile-instance rounding drifts
+    _close(sv, s1.server_vars, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(bank), np.asarray(s1.server_logits),
+        rtol=1e-5, atol=1e-6,
+    )
+
+    # (2) full actor run over the transport stays inside the chaos
+    # envelope of the vmap-vs-unbatched client phase
+    transports = _transports(backend, cfg.data.num_clients + 1, port)
+    server, client_vars = run_gkt_distributed(sim, transports,
+                                              actor_state0)
+    assert server.done.is_set()
+    _close(server.server_vars, state.server_vars, rtol=0.2, atol=2e-2)
+    # teacher logits are the most chaos-amplified quantity (measured
+    # ~0.2 abs drift after 2 rounds from a 4e-5 client-phase seed);
+    # bound gross breakage only — functional equivalence is asserted on
+    # accuracy below, exactness on the part-1 server-phase pin above
+    np.testing.assert_allclose(
+        np.asarray(server.server_logits),
+        np.asarray(state.server_logits), rtol=1.0, atol=0.3,
+    )
+    for i, cv in enumerate(client_vars):
+        _close(cv, jax.tree.map(lambda s: s[i], state.client_stack),
+               rtol=0.2, atol=2e-2)
+
+    def composed_acc(c_vars, s_vars):
+        f, _ = sim._client_apply_eval(c_vars, sim.arrays.test_x)
+        out = sim._server_apply_eval(s_vars, f)
+        return float(jnp.mean(
+            (jnp.argmax(out, -1) == sim.arrays.test_y)
+        ))
+
+    acc_actor = composed_acc(client_vars[0], server.server_vars)
+    acc_sim = composed_acc(
+        jax.tree.map(lambda s: s[0], state.client_stack),
+        state.server_vars,
+    )
+    assert abs(acc_actor - acc_sim) <= 0.15, (acc_actor, acc_sim)

@@ -319,3 +319,98 @@ def test_mlops_logger_over_pubsub_bus():
     assert rec["status"] == "TRAINING" and rec["run_id"] == "run42"
     rec2 = json.loads(got[1][1])
     assert rec2["round"] == 7 and rec2["acc"] == 0.9
+
+
+def test_tensor_rpc_tensor_first_framing_roundtrip():
+    """TensorRpcTransport's tensor-first wire format must round-trip mixed
+    payloads exactly (bulk arrays via the native codec region, scalars and
+    exotic dtypes via the meta pickle)."""
+    from fedml_tpu.core.manager import create_transport
+    from fedml_tpu.core.message import Message
+
+    ip = {0: ("127.0.0.1", 29745), 1: ("127.0.0.1", 29746)}
+    a = create_transport("trpc", 0, ip_config=ip)
+    b = create_transport("trpc", 1, ip_config=ip)
+    a.start()
+    b.start()
+    try:
+        payload = {
+            "big": np.arange(5000, dtype=np.float32).reshape(50, 100),
+            "ints": np.arange(512, dtype=np.int32),
+            "tiny": np.ones((3,), np.float32),  # < 256B: pickle side
+            "bf16": np.ones((300,), np.float16),
+            "scalar": 7,
+            "nested": {"s": "hello", "v": np.full((99,), 2.5, np.float64)},
+        }
+        a.send_message(Message(11, 0, 1, dict(payload)))
+        got = b._inbox.get(timeout=30)
+        assert got.msg_type == 11 and got.sender == 0
+        np.testing.assert_array_equal(got.get("big"), payload["big"])
+        np.testing.assert_array_equal(got.get("ints"), payload["ints"])
+        np.testing.assert_array_equal(got.get("tiny"), payload["tiny"])
+        np.testing.assert_array_equal(got.get("bf16"), payload["bf16"])
+        assert got.get("scalar") == 7
+        assert got.payload["nested"]["s"] == "hello"
+        np.testing.assert_array_equal(
+            got.payload["nested"]["v"], payload["nested"]["v"]
+        )
+        assert got.get("big").flags.writeable
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_checkpoint_scope_migration(tmp_path):
+    """Checkpoints written by pre-Conv2D builds (flax auto-scopes Conv_N /
+    Dense_N) restore into current trees (Conv2D_N / named heads) via the
+    scope-migration shim."""
+    from fedml_tpu.utils.checkpoint import _migrate_scopes
+
+    template = {
+        "params": {
+            "Conv2D_0": {"kernel": np.zeros((3, 3, 3, 8))},
+            "ConvTranspose2D_0": {"kernel": np.zeros((3, 3, 8, 8))},
+            "head": {"kernel": np.zeros((8, 10)), "bias": np.zeros((10,))},
+        }
+    }
+    legacy = {
+        "params": {
+            "Conv_0": {"kernel": np.ones((3, 3, 3, 8))},
+            "ConvTranspose_0": {"kernel": np.full((3, 3, 8, 8), 2.0)},
+            "Dense_0": {"kernel": np.full((8, 10), 3.0),
+                        "bias": np.full((10,), 4.0)},
+        }
+    }
+    out = _migrate_scopes(template, legacy)
+    assert out["params"]["Conv2D_0"]["kernel"][0, 0, 0, 0] == 1.0
+    assert out["params"]["ConvTranspose2D_0"]["kernel"][0, 0, 0, 0] == 2.0
+    assert out["params"]["head"]["bias"][0] == 4.0
+    # unmatched scope -> loud failure, not silent zeros
+    import pytest
+
+    with pytest.raises(KeyError):
+        _migrate_scopes(
+            {"params": {"other": {"kernel": np.zeros((5, 5))}}},
+            legacy,
+        )
+
+
+def test_conv2d_padding_forms():
+    """Conv2D accepts nn.Conv's int / per-dim-int padding forms and
+    rejects CIRCULAR with a clear error."""
+    import jax
+    import jax.numpy as jnp
+    import pytest
+
+    from fedml_tpu.ops.cohort_conv import Conv2D
+
+    x = jnp.ones((1, 8, 8, 3))
+    for pad, hw in [(1, 8), ((2, 1), (10, 8)), ("VALID", 6),
+                    (((1, 1), (1, 1)), 8)]:
+        m = Conv2D(4, (3, 3), padding=pad)
+        y = m.apply(m.init(jax.random.key(0), x), x)
+        want = hw if isinstance(hw, tuple) else (hw, hw)
+        assert y.shape[1:3] == want, (pad, y.shape)
+    m = Conv2D(4, (3, 3), padding="CIRCULAR")
+    with pytest.raises(ValueError, match="CIRCULAR"):
+        m.init(jax.random.key(0), x)
