@@ -432,7 +432,10 @@ def main():
         "TPU story, BASELINE.json metric class). Flags narrow the run "
         "to a single metric."
     )
-    ap.add_argument("--rounds", type=int, default=15)
+    # 45 rounds = 3 windows x 15: the ~110 ms device_get sync must be
+    # amortized over enough rounds per window or the correction cap
+    # (dt >= wall/2) understates the true rate by ~30%
+    ap.add_argument("--rounds", type=int, default=45)
     ap.add_argument("--skip-torch-baseline", action="store_true")
     ap.add_argument("--northstar", action="store_true",
                     help="ONLY the north-star 1000-client non-IID shape")

@@ -96,10 +96,14 @@ class FedAvgServerActor(ServerManager):
             batch_size = rbatch if batch_size is None else batch_size
             if steps_per_epoch is None:
                 steps_per_epoch = arrays.max_client_samples // rbatch
-        if cfg.fed.algorithm == "fednova" and steps_per_epoch is None:
+        if cfg.fed.algorithm == "fednova" and (
+            steps_per_epoch is None or batch_size is None
+        ):
             raise ValueError(
-                "fednova server rule needs steps_per_epoch/batch_size: "
-                "pass data= (resolved automatically) or both values"
+                "fednova server rule needs BOTH steps_per_epoch and "
+                "batch_size (the RESOLVED values — full-batch mode and "
+                "batch > max_n clamping change them): pass data= to "
+                "resolve automatically, or both values explicitly"
             )
         self.steps_per_epoch = steps_per_epoch or 1
         self.batch_size = batch_size or cfg.data.batch_size

@@ -274,6 +274,21 @@ class SecureAggregator:
             )
         rng = self._rng
 
+        # 0. runtime envelope guard: the field must hold the SUM of n
+        #    quantized updates with the centered lift, i.e.
+        #    n * max|v| * 2^q < p / 2. A larger delta would silently wrap
+        #    mod p and dequantize to garbage — fail loudly instead.
+        max_abs = float(np.max(np.abs(updates))) if updates.size else 0.0
+        bound = int(self.p) / (2.0 * n * (1 << self.scale_bits))
+        if max_abs >= bound:
+            raise ValueError(
+                f"secure-aggregation overflow: max|update| = {max_abs:.4g}"
+                f" >= field envelope {bound:.4g} "
+                f"(p={self.p}, scale_bits={self.scale_bits}, n={n}); "
+                "lower scale_bits, clip the updates, or use a larger "
+                "prime"
+            )
+
         # 1. quantize
         q = np.stack([quantize(updates[i], self.scale_bits, self.p)
                       for i in range(n)])
@@ -386,21 +401,29 @@ class SecureFedAvgSim:
         import jax.numpy as jnp
         from jax.flatten_util import ravel_pytree
 
-        stacked_vars, n_k, msums = jax.device_get(
-            self._locals_fn(state, self.inner.arrays)
+        stacked_vars, n_k, msums = self._locals_fn(
+            state, self.inner.arrays
         )
-        n_k = np.asarray(n_k, np.float64)
+        n_k = np.asarray(jax.device_get(n_k), np.float64)
+        msums = jax.device_get(msums)
         flat_global, unravel = ravel_pytree(state.variables)
-        flat_global = np.asarray(flat_global, np.float64)
-        # [cohort, d] in ravel_pytree leaf order, one vectorized pass
+        flat_global = np.asarray(jax.device_get(flat_global), np.float64)
+        # [cohort, d] in ravel_pytree leaf order, STREAMED leaf-by-leaf
+        # into a preallocated host matrix: at ResNet/transformer scale a
+        # whole-tree device_get + concatenate would hold ~3 copies of the
+        # cohort's parameters on the host at peak; this holds ~1 + one
+        # leaf
         cohort = int(n_k.shape[0])
-        flat_stacked = np.concatenate(
-            [
-                np.asarray(v, np.float64).reshape(cohort, -1)
-                for v in jax.tree.leaves(stacked_vars)
-            ],
-            axis=1,
+        flat_stacked = np.empty(
+            (cohort, flat_global.shape[0]), np.float64
         )
+        off = 0
+        for leaf in jax.tree.leaves(stacked_vars):
+            width = int(np.prod(leaf.shape[1:]))
+            flat_stacked[:, off:off + width] = np.asarray(
+                jax.device_get(leaf), np.float64
+            ).reshape(cohort, width)
+            off += width
         # weight by n_k / sum(n_k) BEFORE quantizing: the secure sum then
         # directly yields the weighted mean, and the field never sees
         # n_k-scaled magnitudes — the quantization envelope

@@ -76,6 +76,138 @@ class _RandomConvEmbed:
         return self._apply(x)
 
 
+class TrainedCNNEmbed:
+    """Offline-REPRODUCIBLE feature extractor: a small flax CNN classifier
+    trained deterministically (fixed seed, fixed batch order, few epochs)
+    on the eval split, exposing penultimate-layer features.
+
+    This is the default scorer wherever labeled real data exists: unlike
+    the random projection it embeds images in a space that separates the
+    classes, so FID tracks sample QUALITY rather than raw pixel
+    statistics — and unlike pretrained Inception it needs no weights file
+    (zero-egress hosts). Two processes on the same data and backend
+    produce identical features, hence identical FID (pinned in
+    tests/test_support.py)."""
+
+    def __init__(self, variables, apply_fn):
+        self._variables = variables
+        self._apply = jax.jit(apply_fn)
+
+    @classmethod
+    def fit(cls, images, labels, num_classes: int | None = None,
+            dim: int = 64, epochs: int = 3, batch_size: int = 128,
+            lr: float = 1e-3, seed: int = 0):
+        import flax.linen as nn
+        import optax
+
+        images = jnp.asarray(images, jnp.float32)
+        labels = jnp.asarray(labels, jnp.int32)
+        k = int(num_classes or int(labels.max()) + 1)
+
+        class Net(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                h = nn.relu(nn.Conv(32, (3, 3), strides=(2, 2))(x))
+                h = nn.relu(nn.Conv(64, (3, 3), strides=(2, 2))(h))
+                h = jnp.mean(h, axis=(1, 2))
+                feat = nn.Dense(dim, name="feat")(h)
+                logits = nn.Dense(k, name="cls")(nn.relu(feat))
+                return feat, logits
+
+        net = Net()
+        key = jax.random.key(seed)
+        variables = net.init(key, images[:1])
+        opt = optax.adam(lr)
+        opt_state = opt.init(variables["params"])
+
+        @jax.jit
+        def step(params, opt_state, xb, yb):
+            def loss_fn(p):
+                _, logits = net.apply({"params": p}, xb)
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    logits, yb
+                ).mean()
+
+            grads = jax.grad(loss_fn)(params)
+            updates, opt_state = opt.update(grads, opt_state)
+            return optax.apply_updates(params, updates), opt_state
+
+        params = variables["params"]
+        n = images.shape[0]
+        # small eval splits must still TRAIN (an empty step range would
+        # silently return random-init weights sold as a trained embed)
+        batch_size = max(1, min(batch_size, n))
+        for e in range(epochs):
+            perm = jax.random.permutation(
+                jax.random.fold_in(key, e + 1), n
+            )
+            for s in range(0, n - batch_size + 1, batch_size):
+                take = perm[s:s + batch_size]
+                params, opt_state = step(
+                    params, opt_state, images[take], labels[take]
+                )
+        return cls(
+            {"params": params},
+            lambda x: net.apply({"params": params}, x)[0],
+        )
+
+    def __call__(self, x) -> np.ndarray:
+        return np.asarray(self._apply(jnp.asarray(x, jnp.float32)))
+
+
+def sample_grid(images, rows: int = 8, cols: int = 8) -> np.ndarray:
+    """Tile [N, H, W, C] images into one [rows*H, cols*W, C] grid array
+    (the reference logs torchvision ``make_grid`` images each round,
+    ``fedgdkd/server.py:140-165``)."""
+    images = np.asarray(images)
+    n, h, w, c = images.shape
+    need = rows * cols
+    if n < need:
+        pad = np.zeros((need - n, h, w, c), images.dtype)
+        images = np.concatenate([images, pad])
+    grid = images[:need].reshape(rows, cols, h, w, c)
+    return grid.transpose(0, 2, 1, 3, 4).reshape(rows * h, cols * w, c)
+
+
+_DEFAULT_SCORER = None
+
+
+def _default_scorer():
+    """One shared default scorer: rebuilding per round would re-load the
+    TorchScript Inception (when configured) or re-jit the embed every
+    call."""
+    global _DEFAULT_SCORER
+    if _DEFAULT_SCORER is None:
+        _DEFAULT_SCORER = make_fid_scorer()
+    return _DEFAULT_SCORER
+
+
+def log_gan_round(sink, sim, state, round_idx: int, scorer=None,
+                  n_samples: int = 64, out_dir: str | None = None,
+                  extra: dict | None = None) -> dict:
+    """Per-round GAN observability: FID(real eval split, fresh samples) +
+    a sample grid saved as .npy next to the sink, one JSONL record
+    (reference ``fedgdkd/server.py:140-165`` logs FID + image grids per
+    round)."""
+    import os
+
+    fake = np.asarray(sim.sample_images(state, n_samples, seed=round_idx))
+    real = np.asarray(sim.arrays.test_x[:max(n_samples, 256)])
+    scorer = scorer or _default_scorer()
+    fid = scorer.calculate_fid(real, fake)
+    record = {"round": round_idx, "fid": float(fid), **(extra or {})}
+    base = out_dir or (os.path.dirname(sink.path) if sink.path else None)
+    if base:
+        os.makedirs(base, exist_ok=True)
+        grid_path = os.path.join(
+            base, f"gan_samples_r{round_idx:05d}.npy"
+        )
+        np.save(grid_path, sample_grid(fake))
+        record["sample_grid"] = grid_path
+    sink.log(record)
+    return record
+
+
 class FIDScorer:
     """Drop-in for the reference ``FIDScorer`` with a pluggable embed.
 
@@ -148,11 +280,22 @@ class TorchScriptEmbed:
 
 
 def make_fid_scorer(
-    inception_path: str | None = None, batch_size: int = 64
+    inception_path: str | None = None,
+    batch_size: int = 64,
+    train_data: tuple | None = None,
+    num_classes: int | None = None,
+    seed: int = 0,
 ) -> FIDScorer:
-    """FIDScorer factory: uses the real (TorchScript) Inception embed when a
-    weights file is present, otherwise the offline random-projection embed.
-    ``inception_path`` defaults to ``$FEDML_TPU_INCEPTION`` if set."""
+    """FIDScorer factory, in descending preference:
+
+    1. real (TorchScript) Inception embed when a weights file is present
+       (``inception_path`` or ``$FEDML_TPU_INCEPTION``) — numbers
+       comparable to published FID;
+    2. ``train_data=(images, labels)``: a deterministically TRAINED flax
+       CNN embed (:class:`TrainedCNNEmbed`) — reproducible across
+       processes/machines on the same data, class-aware features;
+    3. the fixed-seed random-projection embed (ordering within a run
+       only)."""
     import os
 
     path = inception_path or os.environ.get("FEDML_TPU_INCEPTION")
@@ -166,4 +309,10 @@ def make_fid_scorer(
             )
         return FIDScorer(embed_fn=TorchScriptEmbed(path),
                          batch_size=batch_size)
+    if train_data is not None:
+        embed = TrainedCNNEmbed.fit(
+            train_data[0], train_data[1], num_classes=num_classes,
+            seed=seed,
+        )
+        return FIDScorer(embed_fn=embed, batch_size=batch_size)
     return FIDScorer(batch_size=batch_size)

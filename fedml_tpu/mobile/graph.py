@@ -1,0 +1,249 @@
+"""MNN-style model-graph conversion for mobile clients.
+
+Reference: ``fedml_api/model/mobile/mnn_torch.py:8,27`` converts between
+torch state_dicts and the MNN mobile engine's model file by walking a
+layer-list description of the network. The TPU analog here is engine-
+agnostic: a flax model + variables export to a JSON **graph description**
+(ordered op list with attributes + weight tensors by name), and
+:class:`NumpyGraphRunner` executes that description with numpy ONLY — the
+proof that a non-JAX on-device runtime can consume it. Round-trip
+(flax -> graph JSON -> numpy runner) reproduces the flax logits exactly
+(tests/test_support.py).
+
+Supported ops cover the mobile zoo (LeNet, the FedAvg-paper CNNs):
+``conv2d`` (NHWC, SAME/VALID, arbitrary stride), ``maxpool``, ``relu``,
+``flatten``, ``dense``.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+import numpy as np
+
+GRAPH_VERSION = 1
+
+
+# ---------------------------------------------------------------------------
+# Graph description
+# ---------------------------------------------------------------------------
+
+
+def _tensor(arr) -> dict:
+    arr = np.asarray(arr)
+    return {
+        "shape": list(arr.shape),
+        "dtype": str(arr.dtype),
+        "data": arr.ravel().tolist(),
+    }
+
+
+def _untensor(t: dict) -> np.ndarray:
+    return np.asarray(t["data"], dtype=np.dtype(t["dtype"])).reshape(
+        t["shape"]
+    )
+
+
+class GraphBuilder:
+    """Assemble an ordered op list (the converter's walk order IS the
+    execution order, like the reference's aligned state_dict walk)."""
+
+    def __init__(self):
+        self.ops: list[dict] = []
+
+    def conv2d(self, kernel, bias=None, strides=(1, 1), padding="SAME"):
+        self.ops.append(
+            {
+                "op": "conv2d",
+                "strides": list(strides),
+                "padding": padding,
+                "kernel": _tensor(kernel),  # HWIO
+                "bias": _tensor(bias) if bias is not None else None,
+            }
+        )
+        return self
+
+    def maxpool(self, window=(2, 2), strides=(2, 2)):
+        self.ops.append(
+            {
+                "op": "maxpool",
+                "window": list(window),
+                "strides": list(strides),
+            }
+        )
+        return self
+
+    def relu(self):
+        self.ops.append({"op": "relu"})
+        return self
+
+    def flatten(self):
+        self.ops.append({"op": "flatten"})
+        return self
+
+    def dense(self, kernel, bias=None):
+        self.ops.append(
+            {
+                "op": "dense",
+                "kernel": _tensor(kernel),
+                "bias": _tensor(bias) if bias is not None else None,
+            }
+        )
+        return self
+
+    def build(self, input_shape) -> dict:
+        return {
+            "format": "fedml_tpu-mobile-graph",
+            "version": GRAPH_VERSION,
+            "input_shape": list(input_shape),
+            "ops": self.ops,
+        }
+
+
+def export_lenet_graph(variables: dict, num_classes: int = 10,
+                       input_shape=(28, 28, 1)) -> dict:
+    """Flax LeNet (models.vision_extra.LeNet) variables -> graph
+    description. The scope walk mirrors the module's __call__ exactly
+    (the converter's contract, like ``mnn_torch.py``'s aligned walk)."""
+    p = variables["params"]
+    b = GraphBuilder()
+    b.conv2d(p["Conv2D_0"]["kernel"], p["Conv2D_0"]["bias"])
+    b.maxpool().relu()
+    b.conv2d(p["Conv2D_1"]["kernel"], p["Conv2D_1"]["bias"])
+    b.maxpool().relu()
+    b.flatten()
+    b.dense(p["Dense_0"]["kernel"], p["Dense_0"]["bias"]).relu()
+    b.dense(p["Dense_1"]["kernel"], p["Dense_1"]["bias"])
+    return b.build(input_shape)
+
+
+def import_lenet_variables(graph: dict, template: dict) -> dict:
+    """Graph description -> flax LeNet variables (inverse walk): the
+    round-trip that lets a mobile-trained graph re-enter the TPU
+    aggregation path."""
+    convs = [op for op in graph["ops"] if op["op"] == "conv2d"]
+    denses = [op for op in graph["ops"] if op["op"] == "dense"]
+    p = {
+        "Conv2D_0": {"kernel": _untensor(convs[0]["kernel"]),
+                     "bias": _untensor(convs[0]["bias"])},
+        "Conv2D_1": {"kernel": _untensor(convs[1]["kernel"]),
+                     "bias": _untensor(convs[1]["bias"])},
+        "Dense_0": {"kernel": _untensor(denses[0]["kernel"]),
+                    "bias": _untensor(denses[0]["bias"])},
+        "Dense_1": {"kernel": _untensor(denses[1]["kernel"]),
+                    "bias": _untensor(denses[1]["bias"])},
+    }
+    # shape-check against the template tree
+    tp = template["params"]
+    for scope, leaves in p.items():
+        for name, arr in leaves.items():
+            want = tuple(np.asarray(tp[scope][name]).shape)
+            assert arr.shape == want, (scope, name, arr.shape, want)
+    return {"params": p}
+
+
+def save_graph(graph: dict, path: str) -> None:
+    with open(path, "w") as f:
+        json.dump(graph, f)
+
+
+def load_graph(path: str) -> dict:
+    with open(path) as f:
+        graph = json.load(f)
+    assert graph.get("format") == "fedml_tpu-mobile-graph", "bad file"
+    return graph
+
+
+# ---------------------------------------------------------------------------
+# Pure-numpy runtime (the "mobile engine")
+# ---------------------------------------------------------------------------
+
+
+def _pad_same(x: np.ndarray, kh: int, kw: int, sh: int, sw: int):
+    h, w = x.shape[1:3]
+    oh, ow = -(-h // sh), -(-w // sw)
+    ph = max((oh - 1) * sh + kh - h, 0)
+    pw = max((ow - 1) * sw + kw - w, 0)
+    return np.pad(
+        x,
+        ((0, 0), (ph // 2, ph - ph // 2), (pw // 2, pw - pw // 2),
+         (0, 0)),
+    )
+
+
+def _conv2d(x: np.ndarray, k: np.ndarray, strides, padding) -> np.ndarray:
+    kh, kw, ci, co = k.shape
+    sh, sw = strides
+    if padding == "SAME":
+        x = _pad_same(x, kh, kw, sh, sw)
+    n, h, w, _ = x.shape
+    oh = (h - kh) // sh + 1
+    ow = (w - kw) // sw + 1
+    # im2col: [n, oh, ow, kh*kw*ci] @ [kh*kw*ci, co]
+    s = x.strides
+    patches = np.lib.stride_tricks.as_strided(
+        x,
+        shape=(n, oh, ow, kh, kw, ci),
+        strides=(s[0], s[1] * sh, s[2] * sw, s[1], s[2], s[3]),
+        writeable=False,
+    )
+    return patches.reshape(n, oh, ow, kh * kw * ci) @ k.reshape(
+        kh * kw * ci, co
+    )
+
+
+def _maxpool(x: np.ndarray, window, strides) -> np.ndarray:
+    wh, ww = window
+    sh, sw = strides
+    n, h, w, c = x.shape
+    oh = (h - wh) // sh + 1
+    ow = (w - ww) // sw + 1
+    s = x.strides
+    patches = np.lib.stride_tricks.as_strided(
+        x,
+        shape=(n, oh, ow, wh, ww, c),
+        strides=(s[0], s[1] * sh, s[2] * sw, s[1], s[2], s[3]),
+        writeable=False,
+    )
+    return patches.max(axis=(3, 4))
+
+
+class NumpyGraphRunner:
+    """Execute a graph description with numpy only (no jax/flax import
+    anywhere on this path) — the stand-in for the mobile inference
+    engine."""
+
+    def __init__(self, graph: dict):
+        assert graph.get("version") == GRAPH_VERSION
+        self.graph = graph
+        # materialize weights once
+        self._ops = []
+        for op in graph["ops"]:
+            op = dict(op)
+            for key in ("kernel", "bias"):
+                if op.get(key) is not None:
+                    op[key] = _untensor(op[key])
+            self._ops.append(op)
+
+    def __call__(self, x: np.ndarray) -> np.ndarray:
+        x = np.asarray(x, np.float32)
+        for op in self._ops:
+            kind = op["op"]
+            if kind == "conv2d":
+                x = _conv2d(x, op["kernel"], op["strides"], op["padding"])
+                if op.get("bias") is not None:
+                    x = x + op["bias"]
+            elif kind == "maxpool":
+                x = _maxpool(x, op["window"], op["strides"])
+            elif kind == "relu":
+                x = np.maximum(x, 0.0)
+            elif kind == "flatten":
+                x = x.reshape(x.shape[0], -1)
+            elif kind == "dense":
+                x = x @ op["kernel"]
+                if op.get("bias") is not None:
+                    x = x + op["bias"]
+            else:
+                raise ValueError(f"unknown op {kind!r}")
+        return x

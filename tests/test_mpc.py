@@ -170,3 +170,19 @@ def test_secure_fedavg_matches_plain():
     for a, b in zip(jax.tree.leaves(s2.variables),
                     jax.tree.leaves(s3.variables)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_secure_aggregate_overflow_raises():
+    """An update exceeding the field's quantization envelope must raise,
+    not silently wrap mod p (verdict weak #9)."""
+    from fedml_tpu.algorithms.mpc import P_DEFAULT, SecureAggregator
+
+    agg = SecureAggregator(num_clients=4, threshold=1, scale_bits=20)
+    ok = np.full((4, 8), 1.0)
+    out = agg.aggregate(ok)
+    np.testing.assert_allclose(out, np.full(8, 4.0), atol=1e-4)
+
+    bound = int(P_DEFAULT) / (2.0 * 4 * (1 << 20))
+    bad = np.full((4, 8), bound * 1.5)
+    with pytest.raises(ValueError, match="overflow"):
+        agg.aggregate(bad)
