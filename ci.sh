@@ -8,55 +8,26 @@
 # Total budget: ~5 min on CPU.
 set -e
 cd "$(dirname "$0")"
+CI_T0=$(date +%s)
 
-export JAX_PLATFORMS=cpu
+# NOTE: no JAX_PLATFORMS export here. The pytest tier forces CPU itself
+# (tests/conftest.py); the smoke matrix + oracle run on the host's
+# default backend — on the bench host that is the tunnelled TPU, whose
+# remote compile is ~3x faster than a cold 1-core local CPU compile for
+# the CNN/ResNet smokes (measured: CPU-forced battery >10 min vs 584s).
+# persistent XLA compile cache: compiles dominate and the battery reruns
+# every round — warm runs are ~2.5x faster
+export JAX_COMPILATION_CACHE_DIR=${JAX_COMPILATION_CACHE_DIR:-/tmp/fedml_tpu_test_xla_cache}
 OUT=$(mktemp -d)
 
 echo "== 1/3 fast test tier =="
 python -m pytest tests -m "not slow" -q -x -p no:cacheprovider
 
 echo "== 2/3 smoke matrix (tiny runs) =="
-smoke() {
-  echo "  -- fedavg $1/$2"
-  python -m fedml_tpu.experiments.run \
-    --algorithm fedavg --dataset "$1" --model "$2" \
-    --client_num_in_total 4 --client_num_per_round 2 --comm_round 2 \
-    --epochs 1 --batch_size 16 --lr 0.03 --frequency_of_the_test 2 \
-    --num_classes "$3" --input_shape $4 --out_dir "$OUT/smoke" \
-    --run_name "smoke_$1_$2" > "$OUT/smoke_$1_$2.json"
-}
-smoke synthetic    lr       10 "60"
-smoke fake_mnist   lr       10 "28 28 1"
-smoke fake_mnist   cnn      10 "28 28 1"
-smoke fake_cifar10 resnet20 10 "32 32 3"
-smoke fake_shakespeare rnn  90 "80"
-smoke fake_stackoverflow_lr tag_lr 50 "1000"
-
-# robust-aggregation smoke (reference CI-script-fedavg-robust.sh)
-echo "  -- fedavg_robust fake_mnist/lr"
-python -m fedml_tpu.experiments.run \
-  --algorithm fedavg_robust --dataset fake_mnist --model lr \
-  --client_num_in_total 4 --client_num_per_round 4 --comm_round 2 \
-  --epochs 1 --batch_size 16 --num_classes 10 --input_shape 28 28 1 \
-  --robust_method median --robust_norm_clip 1.0 \
-  --robust_noise_stddev 0.001 \
-  --out_dir "$OUT/smoke" --run_name smoke_robust > "$OUT/smoke_robust.json"
-echo "  -- vfl (two-party vertical, procedural)"
-python -m fedml_tpu.experiments.run \
-  --algorithm vfl --dataset fake_vfl --comm_round 4 --lr 0.1 \
-  --batch_size 32 --frequency_of_the_test 4 \
-  --out_dir "$OUT/smoke" --run_name smoke_vfl > "$OUT/smoke_vfl.json"
-echo "  -- turboaggregate (secure aggregation)"
-python -m fedml_tpu.experiments.run \
-  --algorithm turboaggregate --dataset fake_mnist --model lr \
-  --client_num_in_total 8 --client_num_per_round 4 --comm_round 2 \
-  --num_classes 10 --input_shape 28 28 1 --frequency_of_the_test 2 \
-  --out_dir "$OUT/smoke" --run_name smoke_ta > "$OUT/smoke_ta.json"
-echo "  -- decentralized dol_dsgd (regret)"
-python -m fedml_tpu.experiments.run \
-  --algorithm dol_dsgd --dataset fake_susy --client_num_in_total 4 \
-  --comm_round 50 --lr 0.3 --out_dir "$OUT/smoke" \
-  --run_name smoke_dol > "$OUT/smoke_dol.json"
+# one process for the whole matrix: same CLI argv surface via
+# run.main(argv), but jax/backend startup and compile caches paid once
+# (was: ~10 separate interpreter launches)
+python scripts/smoke_matrix.py "$OUT/smoke"
 
 if [ "${1:-}" = "full" ]; then
   # slow-compiling batteries, mirroring the reference's separate
@@ -93,4 +64,4 @@ assert a == b == c, f"oracle mismatch: fedavg={a} centralized={b} hierarchical={
 print(f"oracle ok: train_acc {a} == {b} == {c}")
 EOF
 
-echo "CI battery passed."
+echo "CI battery passed in $(( $(date +%s) - CI_T0 ))s."

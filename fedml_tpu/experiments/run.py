@@ -11,7 +11,18 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
+import os
 import sys
+
+# Honor JAX_PLATFORMS even on hosts whose sitecustomize pins the platform
+# via jax.config (where the env var alone is silently ignored). This is
+# the general escape hatch for forcing a backend on such hosts — e.g.
+# JAX_PLATFORMS=cpu for a deterministic CPU run; when unset, the host's
+# default backend is used.
+if os.environ.get("JAX_PLATFORMS"):
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 from fedml_tpu.config import ExperimentConfig
 from fedml_tpu.experiments.harness import ALGORITHMS, Experiment

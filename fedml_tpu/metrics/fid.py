@@ -196,7 +196,9 @@ def log_gan_round(sink, sim, state, round_idx: int, scorer=None,
     scorer = scorer or _default_scorer()
     fid = scorer.calculate_fid(real, fake)
     record = {"round": round_idx, "fid": float(fid), **(extra or {})}
-    base = out_dir or (os.path.dirname(sink.path) if sink.path else None)
+    base = out_dir or (
+        (os.path.dirname(sink.path) or ".") if sink.path else None
+    )
     if base:
         os.makedirs(base, exist_ok=True)
         grid_path = os.path.join(

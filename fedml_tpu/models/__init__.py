@@ -75,6 +75,17 @@ def create_model(cfg: ModelConfig) -> FedModel:
         # it for EVERY resnet variant (exact cross-shard BN on the named
         # mesh axis — models.vision.SyncBatchNorm)
         base = name[len("resnet"):]
+        if base.endswith("_s2d_exact"):
+            # EXACT s2d execution layout of the standard (BN) ResNet:
+            # weight-compatible with resnet<depth> checkpoints through
+            # models.s2d_exact.convert_resnet_checkpoint_to_s2d
+            from fedml_tpu.models.s2d_exact import ResNetCIFARS2DExact
+
+            depth = int(base[: -len("_s2d_exact")])
+            return FedModel(
+                ResNetCIFARS2DExact(depth, nc), cfg.input_shape,
+                has_batch_stats=True,
+            )
         s2d = base.endswith("_s2d")
         if s2d:
             base = base[: -len("_s2d")]

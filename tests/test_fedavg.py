@@ -236,14 +236,14 @@ def test_cohort_groups_equal_single_group():
     class as fused-vs-vmapped; exact here because the model is BN-free)."""
     base = dict(
         data=DataConfig(
-            dataset="fake_cifar10", num_clients=12, batch_size=16, seed=0,
-            partition_method="hetero", partition_alpha=0.5, dataset_r=0.2,
+            dataset="fake_cifar10", num_clients=8, batch_size=16, seed=0,
+            partition_method="hetero", partition_alpha=0.5, dataset_r=0.1,
         ),
         model=ModelConfig(
             name="cnn_custom", num_classes=10, input_shape=(32, 32, 3),
-            extra=(("convs", (8, 16)), ("denses", (32,))),
+            extra=(("convs", (8,)), ("denses", (16,))),
         ),
-        fed=FedConfig(num_rounds=3, clients_per_round=6, eval_every=10),
+        fed=FedConfig(num_rounds=2, clients_per_round=4, eval_every=10),
         seed=0,
     )
     states = {}
@@ -257,7 +257,7 @@ def test_cohort_groups_equal_single_group():
         assert sim._cohort_update is not None, "fused path must be active"
         assert sim._cohort_groups == groups
         st = sim.init()
-        for _ in range(3):
+        for _ in range(2):
             st, _ = sim.run_round(st)
         states[groups] = st
     a = jax.tree.leaves(states[1].variables["params"])

@@ -137,3 +137,93 @@ def test_sync_batchnorm_exact_across_shards():
         check_vma=False,
     )(x[:, :, :, :3])
     assert "batch_stats" in v2
+
+
+def test_s2d_exact_matches_standard_resnet():
+    """The exact s2d execution layout + checkpoint converter: a standard
+    ResNetCIFAR's variables converted through
+    convert_resnet_checkpoint_to_s2d produce the SAME function (eval
+    logits and train-mode forward) in the TPU-friendly layout — the
+    parity bridge that lets reference-layout checkpoints run s2d."""
+    import jax
+    import jax.numpy as jnp
+
+    from fedml_tpu.models.s2d_exact import (
+        ResNetCIFARS2DExact,
+        convert_resnet_checkpoint_to_s2d,
+    )
+    from fedml_tpu.models.vision import ResNetCIFAR
+
+    depth = 20  # n=3: same structure class as 56, 3x faster to compile
+    std = ResNetCIFAR(depth=depth, num_classes=10, norm="bn")
+    s2d = ResNetCIFARS2DExact(depth=depth, num_classes=10)
+    x = jax.random.normal(jax.random.key(0), (4, 32, 32, 3))
+    v_std = std.init(jax.random.key(1), x, train=False)
+    v_s2d = convert_resnet_checkpoint_to_s2d(v_std, depth=depth)
+
+    # structure check against a fresh init
+    ref_tree = jax.tree.structure(
+        s2d.init(jax.random.key(2), x, train=False)
+    )
+    assert jax.tree.structure(v_s2d) == ref_tree
+
+    want = std.apply(v_std, x, train=False)
+    got = s2d.apply(v_s2d, x, train=False)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-4
+    )
+
+    # train mode: phase-pooled BN must reproduce the original batch
+    # statistics (forward outputs equal)
+    want_t, wmut = std.apply(
+        v_std, x, train=True, mutable=["batch_stats"]
+    )
+    got_t, gmut = s2d.apply(
+        v_s2d, x, train=True, mutable=["batch_stats"]
+    )
+    np.testing.assert_allclose(
+        np.asarray(got_t), np.asarray(want_t), rtol=2e-4, atol=2e-4
+    )
+    # updated running stats of the stem BN: converted = tile4(original)
+    src_bn = wmut["batch_stats"]["BatchNorm_0"]["mean"]
+    dst_bn = gmut["batch_stats"]["PhasePooledBatchNorm_0"]["mean"]
+    np.testing.assert_allclose(
+        np.asarray(dst_bn), np.tile(np.asarray(src_bn), 4),
+        rtol=1e-4, atol=1e-5,
+    )
+
+
+def test_s2d_exact_cohort_equals_vmap_single_apply():
+    """The exact-s2d model's cohort-grouped (fat) application equals the
+    vmapped per-client application to f32 round-off (trajectory-level
+    equality is chaos-bounded like every BN net; single applications are
+    the layout pin)."""
+    import jax
+    import jax.numpy as jnp
+
+    from fedml_tpu.config import ModelConfig
+    from fedml_tpu.models import create_model
+
+    m = create_model(
+        ModelConfig(name="resnet8_s2d_exact", num_classes=10,
+                    input_shape=(32, 32, 3))
+    )
+    assert m.supports_cohort()
+    C, B = 3, 4
+    k = jax.random.key(0)
+    v = m.init(k)
+    stacked = jax.tree.map(
+        lambda a: jnp.stack([a + 0.01 * i for i in range(C)]), v
+    )
+    x = jax.random.normal(jax.random.fold_in(k, 1), (C, B, 32, 32, 3))
+    lv, lvars = jax.vmap(
+        lambda sv, xb: m.apply_train(sv, xb, jax.random.key(9))
+    )(stacked, x)
+    cv, cvars = m.apply_cohort_train(stacked, x, jax.random.key(9))
+    np.testing.assert_allclose(
+        np.asarray(cv), np.asarray(lv), rtol=1e-5, atol=2e-6
+    )
+    for a, b in zip(jax.tree.leaves(lvars), jax.tree.leaves(cvars)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=2e-6
+        )

@@ -27,6 +27,7 @@ def test_expert_parallel_moe_matches_reference():
                                atol=1e-5, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_expert_parallel_moe_capacity_drops():
     """Tokens over capacity are dropped to zero (standard MoE semantics),
     never NaN/garbage."""

@@ -99,10 +99,14 @@ def test_data_axis_matches_single_device():
 @pytest.mark.parametrize("fed", [
     FedConfig(num_rounds=1, clients_per_round=8, eval_every=1,
               algorithm="fednova"),
-    FedConfig(num_rounds=1, clients_per_round=8, eval_every=1,
-              robust_method="median"),
-    FedConfig(num_rounds=1, clients_per_round=8, eval_every=1,
-              robust_norm_clip=1.0),
+    pytest.param(
+        FedConfig(num_rounds=1, clients_per_round=8, eval_every=1,
+                  robust_method="median"),
+        marks=pytest.mark.slow),
+    pytest.param(
+        FedConfig(num_rounds=1, clients_per_round=8, eval_every=1,
+                  robust_norm_clip=1.0),
+        marks=pytest.mark.slow),
 ])
 def test_sharded_variants_match(fed):
     mesh = make_mesh(client_axis=4, data_axis=1)
@@ -199,6 +203,7 @@ def test_sharded_cohort_path_matches_single_device():
         )
 
 
+@pytest.mark.slow
 def test_sharded_cohort_one_client_per_shard():
     """cohort_per_shard == 1 (clients_per_round == n_shards): the
     degenerate cohort must route through the per-client apply (stacked

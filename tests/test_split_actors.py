@@ -66,12 +66,13 @@ def _tiny_cfg(num_clients=3, rounds=2):
 
 
 @pytest.mark.parametrize("backend,port", [("loopback", 0),
-                                          ("grpc", 29760)])
+                                          pytest.param("grpc", 29760,
+                                                       marks=pytest.mark.slow)])
 def test_splitnn_actors_match_sim(backend, port):
     """Activations/cut-gradients over Messages == joint-autodiff sim:
     server weights, every client's lower stack, and train metrics."""
-    cfg = _tiny_cfg()
-    data = make_fake_image_dataset("mnist", cfg.data, n_train=72,
+    cfg = _tiny_cfg(num_clients=2)
+    data = make_fake_image_dataset("mnist", cfg.data, n_train=48,
                                    n_test=24)
     client_model = SplitClientNet(features=(8, 16))
     server_model = SplitServerNet(num_classes=10, hidden=32)

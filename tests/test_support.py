@@ -484,6 +484,11 @@ print(repr(scorer.calculate_fid(x, fake)))
     env = dict(
         os.environ,
         PYTHONPATH=repo + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        # warm XLA cache: the two child processes would otherwise pay
+        # cold jits, busting the fast tier's budget
+        JAX_COMPILATION_CACHE_DIR=os.environ.get(
+            "FEDML_TPU_TEST_CACHE", "/tmp/fedml_tpu_test_xla_cache"
+        ),
     )
     outs = []
     for _ in range(2):
