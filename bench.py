@@ -15,6 +15,10 @@ supplementary fields:
   intentionally conservative: cohort-lockstep padding and XLA's
   dense expansion of grouped convolutions are charged against it.
 - ``hbm_util``: same useful-work accounting against peak HBM bandwidth.
+  The bytes numerator is XLA's static "bytes accessed" for ONE
+  training step; values above 1.0 mean the executed round moves fewer
+  bytes than that model charges (XLA fusion eliminating intermediate
+  traffic) — an accounting artifact, not a physics violation.
   At ResNet-56's CIFAR channel widths (16-64 per client) per-client
   convolutions cannot tile the 128x128 MXU, so the round is
   bandwidth/lowering-bound, not FLOP-bound; the round program (cohort-
