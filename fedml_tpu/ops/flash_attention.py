@@ -9,6 +9,15 @@ materializing the [T, T] score matrix.
 On non-TPU backends (the CI's virtual CPU mesh) the kernel runs in pallas
 interpret mode; for large sequences prefer the compiled XLA fallback
 (:func:`fedml_tpu.ops.ring_attention.full_attention`) on CPU.
+
+Measured honestly on v5e (B=4, H=8, D=64, bf16, causal): XLA's fused
+attention (``full_attention``) is 6-11x FASTER than this kernel at
+T=2048-8192 — the XLA TPU attention fusion is excellent and this
+hand-tiled kernel does not beat it. ``TransformerLM`` therefore defaults
+to ``full_attention``; use this kernel when the [T, T] score matrix must
+never materialize in HBM at sequence lengths where XLA's fusion would
+spill (or shard the sequence with
+:func:`fedml_tpu.ops.ring_attention.ring_attention` instead).
 """
 
 from __future__ import annotations
