@@ -174,6 +174,12 @@ class ExperimentConfig:
     seed: int = 0
     run_name: str = "run"
     out_dir: str = "./runs"
+    # checkpoint the sim state every N rounds into <out_dir>/<run>/ckpt
+    # and RESUME from the latest checkpoint on restart (orbax round
+    # state, utils/checkpoint.py — the reference has no framework-level
+    # checkpointing, SURVEY.md §5.4). 0 = off. Applies to sims driven by
+    # the harness's init/run_round protocol.
+    checkpoint_every: int = 0
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), default=str, indent=2)
@@ -217,4 +223,5 @@ class ExperimentConfig:
             seed=d.get("seed", 0),
             run_name=d.get("run_name", "run"),
             out_dir=d.get("out_dir", "./runs"),
+            checkpoint_every=d.get("checkpoint_every", 0),
         )

@@ -337,15 +337,70 @@ class Experiment:
     @staticmethod
     def _run_sim(sim, cfg: ExperimentConfig, sink: MetricsSink):
         """Drive any sim shape: prefer its own ``run``; else the
-        run_round/evaluate protocol."""
-        if hasattr(sim, "run") and not isinstance(sim, type):
-            try:
-                sim.run(metrics_sink=sink)
-                return
-            except TypeError:
-                pass
+        run_round/evaluate protocol. With ``cfg.checkpoint_every`` > 0
+        the generic loop takes over for sims exposing the
+        init/run_round state protocol, so round state checkpoints
+        atomically every N rounds and a restarted run resumes from the
+        latest step. Sims without device-resident round state
+        (host-driven or run-only shapes) cannot checkpoint — the flag
+        warns and falls back to a plain run. On resume after a
+        mid-interval crash, rounds after the last checkpoint re-run and
+        re-log: metrics.jsonl may carry a duplicate round record (the
+        later, post-``resumed_from`` one is authoritative)."""
+        ckpt = None
+        start_round = 0
+        checkpointable = (
+            cfg.checkpoint_every > 0
+            and hasattr(sim, "init")
+            and hasattr(sim, "run_round")
+        )
+        if cfg.checkpoint_every > 0 and not checkpointable:
+            import warnings
+
+            warnings.warn(
+                f"checkpoint_every={cfg.checkpoint_every} ignored: "
+                f"{type(sim).__name__} does not expose the "
+                "init/run_round state protocol",
+                stacklevel=2,
+            )
+        if not checkpointable:
+            if hasattr(sim, "run") and not isinstance(sim, type):
+                try:
+                    sim.run(metrics_sink=sink)
+                    return
+                except TypeError:
+                    pass
         state = sim.init() if hasattr(sim, "init") else None
-        for r in range(cfg.fed.num_rounds):
+        if checkpointable and state is not None:
+            from fedml_tpu.utils.checkpoint import RoundCheckpointer
+
+            ckpt = RoundCheckpointer(
+                os.path.join(
+                    os.path.dirname(sink.path) if sink.path else
+                    cfg.out_dir, "ckpt"
+                )
+            )
+            state, start_round = ckpt.restore_or(state)
+            if start_round:
+                sink.log({"resumed_from": start_round})
+        elif checkpointable:
+            import warnings
+
+            warnings.warn(
+                "checkpoint_every ignored: sim has no device-resident "
+                "round state (init() returned None)",
+                stacklevel=2,
+            )
+        try:
+            Experiment._round_loop(sim, cfg, sink, state, start_round,
+                                   ckpt)
+        finally:
+            if ckpt is not None:
+                ckpt.close()
+
+    @staticmethod
+    def _round_loop(sim, cfg, sink, state, start_round, ckpt):
+        for r in range(start_round, cfg.fed.num_rounds):
             if state is None:  # host-driven sims (HeteroFedGDKD)
                 m = sim.run_round()
             else:
@@ -372,6 +427,11 @@ class Experiment:
                         )
                         break
             sink.log(record)
+            if ckpt is not None and (
+                (r + 1) % cfg.checkpoint_every == 0
+                or r == cfg.fed.num_rounds - 1
+            ):
+                ckpt.save(r, state)
 
 
 def _wants_round(sim) -> bool:

@@ -66,6 +66,10 @@ def parse_args(argv=None) -> tuple[ExperimentConfig, int]:
     p.add_argument("--repetitions", type=int, default=1)
     p.add_argument("--run_name", type=str, default=None)
     p.add_argument("--out_dir", type=str, default=None)
+    p.add_argument("--checkpoint_every", type=int, default=None,
+                   help="checkpoint round state every N rounds into "
+                        "<out_dir>/<run>/ckpt and resume from the "
+                        "latest checkpoint on restart (0 = off)")
     a = p.parse_args(argv)
 
     if a.config:
@@ -117,6 +121,7 @@ def parse_args(argv=None) -> tuple[ExperimentConfig, int]:
         seed=a.seed,
         run_name=a.run_name,
         out_dir=a.out_dir,
+        checkpoint_every=a.checkpoint_every,
     )
     return cfg, a.repetitions
 
