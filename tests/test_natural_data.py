@@ -557,3 +557,39 @@ def test_leaf_text_shakespeare_json(tmp_path):
     # shifted: y[:, :-1] == x[:, 1:], last y col is the LEAF next char
     np.testing.assert_array_equal(data.y_train[0, :-1], data.x_train[0, 1:])
     assert data.y_train[0, -1] == char_id["t"]
+
+
+def test_imagenet_remainder_dealing_and_test_maps(tmp_path):
+    """classes % clients != 0: remainder classes deal one each to the
+    first clients (no divisibility assert), and the vectorized per-client
+    test maps give each client exactly its own classes' val images."""
+    from PIL import Image
+
+    from fedml_tpu.data.largescale import load_imagenet
+
+    rng = np.random.default_rng(1)
+    classes = ["c%02d" % i for i in range(5)]
+    for split, n in (("train", 2), ("val", 2)):
+        for c in classes:
+            d = tmp_path / split / c
+            d.mkdir(parents=True)
+            for i in range(n):
+                Image.fromarray(
+                    rng.integers(0, 255, (8, 8, 3)).astype(np.uint8)
+                ).save(d / f"{c}_{i}.jpg")
+    data = load_imagenet(str(tmp_path), client_number=2, image_size=8)
+    # 5 classes over 2 clients: client 0 gets {0,1,2}, client 1 {3,4}
+    assert set(data.y_train[data.train_idx_map[0]]) == {0, 1, 2}
+    assert set(data.y_train[data.train_idx_map[1]]) == {3, 4}
+    # per-client test maps cover the val set disjointly, own classes only
+    te0 = set(map(int, data.test_idx_map[0]))
+    te1 = set(map(int, data.test_idx_map[1]))
+    assert te0.isdisjoint(te1)
+    assert len(te0) + len(te1) == len(data.y_test)
+    assert set(data.y_test[sorted(te0)]) == {0, 1, 2}
+    assert set(data.y_test[sorted(te1)]) == {3, 4}
+    # too many clients for the class count fails loudly
+    import pytest
+
+    with pytest.raises(ValueError, match="dealt"):
+        load_imagenet(str(tmp_path), client_number=6, image_size=8)
