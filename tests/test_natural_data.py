@@ -308,22 +308,30 @@ def test_real_text_datasets_via_dispatch(tmp_path):
     assert data.task == "nwp" and data.num_clients == 1
 
 
-def test_imagenet_by_class_partition(tmp_path):
-    """ImageNet federated partition: classes dealt to clients in sorted
-    order (reference load_partition_data_ImageNet:235-243)."""
+def _make_image_tree(tmp_path, classes, per_split, size=8, seed=0):
+    """ImageFolder tree train/<class>/*.jpg + val/<class>/*.jpg."""
     from PIL import Image
 
-    from fedml_tpu.data.largescale import load_imagenet
-
-    rng = np.random.default_rng(0)
-    for split, n in (("train", 3), ("val", 1)):
-        for c in ("n01440764", "n01443537", "n01484850", "n01491361"):
+    rng = np.random.default_rng(seed)
+    for split, n in per_split.items():
+        for c in classes:
             d = tmp_path / split / c
             d.mkdir(parents=True)
             for i in range(n):
                 Image.fromarray(
-                    rng.integers(0, 255, (8, 8, 3)).astype(np.uint8)
-                ).save(d / f"{c}_{i}.JPEG".replace("JPEG", "jpg"))
+                    rng.integers(0, 255, (size, size, 3)).astype(np.uint8)
+                ).save(d / f"{c}_{i}.jpg")
+
+
+def test_imagenet_by_class_partition(tmp_path):
+    """ImageNet federated partition: classes dealt to clients in sorted
+    order (reference load_partition_data_ImageNet:235-243)."""
+    from fedml_tpu.data.largescale import load_imagenet
+
+    _make_image_tree(
+        tmp_path, ("n01440764", "n01443537", "n01484850", "n01491361"),
+        {"train": 3, "val": 1},
+    )
     data = load_imagenet(str(tmp_path), client_number=2, image_size=8)
     assert data.num_clients == 2 and data.num_classes == 4
     # client 0 owns classes {0,1}, client 1 owns {2,3}
@@ -563,20 +571,10 @@ def test_imagenet_remainder_dealing_and_test_maps(tmp_path):
     """classes % clients != 0: remainder classes deal one each to the
     first clients (no divisibility assert), and the vectorized per-client
     test maps give each client exactly its own classes' val images."""
-    from PIL import Image
-
     from fedml_tpu.data.largescale import load_imagenet
 
-    rng = np.random.default_rng(1)
-    classes = ["c%02d" % i for i in range(5)]
-    for split, n in (("train", 2), ("val", 2)):
-        for c in classes:
-            d = tmp_path / split / c
-            d.mkdir(parents=True)
-            for i in range(n):
-                Image.fromarray(
-                    rng.integers(0, 255, (8, 8, 3)).astype(np.uint8)
-                ).save(d / f"{c}_{i}.jpg")
+    _make_image_tree(tmp_path, ["c%02d" % i for i in range(5)],
+                     {"train": 2, "val": 2}, seed=1)
     data = load_imagenet(str(tmp_path), client_number=2, image_size=8)
     # 5 classes over 2 clients: client 0 gets {0,1,2}, client 1 {3,4}
     assert set(data.y_train[data.train_idx_map[0]]) == {0, 1, 2}
@@ -589,7 +587,5 @@ def test_imagenet_remainder_dealing_and_test_maps(tmp_path):
     assert set(data.y_test[sorted(te0)]) == {0, 1, 2}
     assert set(data.y_test[sorted(te1)]) == {3, 4}
     # too many clients for the class count fails loudly
-    import pytest
-
     with pytest.raises(ValueError, match="dealt"):
         load_imagenet(str(tmp_path), client_number=6, image_size=8)
