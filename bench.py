@@ -291,28 +291,26 @@ def _enable_compile_cache():
         pass  # older jax or unsupported backend: compile uncached
 
 
-_ROUND_CACHE: dict = {}
-
-
 def _compiled_round(sim, cache: bool = False):
     """AOT-compile the round ONCE; the same executable serves warmup and
     the timed loop (utilization numbers come from useful_round_cost's
     separate single-step program — the round's own cost analysis is
     meaningless with a data-dependent trip count). ``cache=True`` reuses
     the executable across suite stages sharing ONE sim (tta + headline);
-    cached entries pin the sim's device arrays, so the default suite only
-    caches the stage pair that benefits and clears afterwards."""
+    the cached runner lives as an attribute ON the sim (not a global
+    keyed by id(sim), which a later build_sim object could collide with
+    after ``del sim``) so it is freed exactly when the sim is."""
     import jax
 
     state = sim.init()
-    run_round = _ROUND_CACHE.get(id(sim)) if cache else None
+    run_round = getattr(sim, "_bench_cached_round", None) if cache else None
     if run_round is None:
         compiled = jax.jit(sim._round, donate_argnums=(0,)).lower(
             state, sim.arrays
         ).compile()
         run_round = lambda st: compiled(st, sim.arrays)
         if cache:
-            _ROUND_CACHE[id(sim)] = run_round
+            sim._bench_cached_round = run_round
     state, _ = run_round(state)  # warmup (execute once)
     jax.block_until_ready(state.variables)
     return run_round, state
@@ -512,7 +510,7 @@ def main():
         s2d_sim, "fedavg_rounds_per_sec_100c_cifar10_resnet56_s2d",
         args.rounds, True, args.skip_torch_baseline, cache=True,
     ))
-    _ROUND_CACHE.clear()
+    del s2d_sim  # frees the cached compiled round with it
 
 
 if __name__ == "__main__":

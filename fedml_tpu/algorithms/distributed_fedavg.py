@@ -105,8 +105,16 @@ class FedAvgServerActor(ServerManager):
                 "batch > max_n clamping change them): pass data= to "
                 "resolve automatically, or both values explicitly"
             )
-        self.steps_per_epoch = steps_per_epoch or 1
-        self.batch_size = batch_size or cfg.data.batch_size
+        # explicit 0 is a caller bug (would silently skew FedNova tau if
+        # coerced to 1) — reject rather than repair
+        if steps_per_epoch is not None and steps_per_epoch < 1:
+            raise ValueError(
+                f"steps_per_epoch must be >= 1, got {steps_per_epoch}"
+            )
+        if batch_size is not None and batch_size < 1:
+            raise ValueError(f"batch_size must be >= 1, got {batch_size}")
+        self.steps_per_epoch = 1 if steps_per_epoch is None else steps_per_epoch
+        self.batch_size = cfg.data.batch_size if batch_size is None else batch_size
         self.root_key = jax.random.key(cfg.seed)
         self.round_idx = 0
         self._results: dict[int, tuple[dict, float]] = {}

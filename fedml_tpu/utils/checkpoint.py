@@ -66,9 +66,12 @@ class RoundCheckpointer:
             restored = self._mgr.restore(
                 step, args=ocp.args.StandardRestore(template)
             )
-        except Exception as err:
+        except (ValueError, KeyError, TypeError) as err:
             # structure mismatch (e.g. legacy scope names): raw-restore
-            # and remap keys against the template. Migration is strict
+            # and remap keys against the template. Transient I/O errors
+            # (OSError etc.) propagate directly — only the error classes
+            # orbax raises for template/key mismatches enter the
+            # migration path. Migration is strict
             # (unique shape matches only) and re-raises the ORIGINAL
             # error when it cannot resolve, so a wrong-experiment or
             # corrupted checkpoint still fails loudly instead of loading
