@@ -426,6 +426,74 @@ def time_to_acc_record(sim, model_name: str, target: float,
     }
 
 
+REFERENCE_SYNTH_DIR = "/root/reference/data/synthetic_1_1"
+
+
+def synthetic_leaf_acc_record(max_rounds: int = 200) -> dict | None:
+    """Accuracy parity on REAL data: FedAvg + LogisticRegression on the
+    reference's in-tree LEAF ``synthetic(1,1)`` files with the reference
+    benchmark hyperparameters (30 clients, 10/round, batch 10, SGD lr
+    .01, 1 epoch — ``benchmark/README.md:14``; bar: >60 test acc within
+    >200 rounds). The train split is the exact complement of the shipped
+    test files in the seeded FedProx generation
+    (fedml_tpu.data.natural.load_synthetic_leaf). Returns None (with a
+    stderr note) when the reference files are absent."""
+    import os
+
+    if not os.path.exists(
+        os.path.join(REFERENCE_SYNTH_DIR, "test", "mytest.json")
+    ):
+        print(
+            "[bench] reference LEAF synthetic files absent; skipping "
+            "synthetic_acc", file=sys.stderr, flush=True,
+        )
+        return None
+    from fedml_tpu.config import (
+        DataConfig, ExperimentConfig, FedConfig, ModelConfig, TrainConfig,
+    )
+    from fedml_tpu.algorithms.fedavg import FedAvgSim
+    from fedml_tpu.data.loaders import load_dataset
+    from fedml_tpu.models import create_model
+
+    cfg = ExperimentConfig(
+        data=DataConfig(dataset="leaf_synthetic",
+                        data_dir=REFERENCE_SYNTH_DIR,
+                        num_clients=30, batch_size=10, seed=0),
+        model=ModelConfig(name="lr", num_classes=10, input_shape=(60,)),
+        train=TrainConfig(lr=0.01, epochs=1),
+        fed=FedConfig(num_rounds=max_rounds, clients_per_round=10,
+                      eval_every=10**9),
+        seed=0,
+    )
+    data = load_dataset(cfg.data)
+    sim = FedAvgSim(create_model(cfg.model), data, cfg)
+    state = sim.init()
+    t0 = time.perf_counter()
+    best_acc, best_round = 0.0, None
+    for r in range(max_rounds):
+        state, _ = sim.run_round(state)
+        if (r + 1) % 10 == 0:
+            acc = sim.evaluate_global(state)["acc"]
+            if acc > best_acc:
+                best_acc, best_round = acc, r + 1
+    final_acc = sim.evaluate_global(state)["acc"]
+    if final_acc > best_acc:
+        best_acc, best_round = final_acc, max_rounds
+    return {
+        "metric": "synthetic_1_1_fedavg_lr_test_acc_200r_real_leaf",
+        "value": round(final_acc * 100, 2),
+        "unit": "% test acc",
+        # reference bar: >60 WITHIN 200 rounds (benchmark/README.md:14)
+        # — that is a best-so-far criterion, so vs_baseline uses best_acc
+        "vs_baseline": round(best_acc * 100 / 60.0, 2),
+        "best_acc": round(best_acc * 100, 2),
+        "best_round": best_round,
+        "rounds": max_rounds,
+        "wall_s": round(time.perf_counter() - t0, 1),
+        "data": "real LEAF synthetic_1_1 (reference in-tree files)",
+    }
+
+
 def main():
     ap = argparse.ArgumentParser(
         description="Plain `python bench.py` (what the driver runs) "
@@ -453,6 +521,8 @@ def main():
     ap.add_argument("--target-acc", type=float, default=None,
                     help="ONLY time-to-accuracy at this target")
     ap.add_argument("--max-rounds", type=int, default=2000)
+    ap.add_argument("--synthetic-acc", action="store_true",
+                    help="ONLY the real-LEAF synthetic(1,1) accuracy row")
     args = ap.parse_args()
 
     _enable_compile_cache()
@@ -467,6 +537,11 @@ def main():
             flush=True,
         )
 
+    if args.synthetic_acc:
+        rec = synthetic_leaf_acc_record()
+        if rec:
+            emit(rec)
+        return
     if args.target_acc is not None:
         model_name = "resnet56_s2d" if args.s2d else "resnet56"
         sim, _ = build_sim(model_name=model_name)
@@ -490,6 +565,14 @@ def main():
         return
 
     # ---- default: the full driver suite, headline LAST ----
+    try:
+        rec = synthetic_leaf_acc_record()
+    except Exception as err:  # an accuracy-row failure must never
+        rec = None            # abort the rounds/sec suite below
+        print(f"[bench] synthetic_acc failed: {err}", file=sys.stderr,
+              flush=True)
+    if rec:
+        emit(rec)
     sim, _ = build_sim(model_name="resnet56")
     emit(rate_record(
         sim, "fedavg_rounds_per_sec_100c_cifar10_resnet56",

@@ -524,8 +524,25 @@ def load_dataset(cfg: DataConfig) -> FederatedData:
                 cfg.data_dir, SHAKESPEARE_VOCAB_SIZE, task="nwp",
                 offline_hint="fake_shakespeare", text=True,
             )
-        shapes = {"femnist": ((28, 28, 1), 62), "celeba": ((84, 84, 3), 2),
-                  "synthetic": (None, 10)}
+        if base == "synthetic":
+            # REAL LEAF synthetic(a, b) files; (a, b) parsed from the
+            # directory name (synthetic_1_1, synthetic_0.5_0.5, ...)
+            # when it follows that convention. A non-conventional name is
+            # fine as long as train/mytrain.json exists — (a, b) are only
+            # needed to RECONSTRUCT a missing train split.
+            from fedml_tpu.data.natural import load_synthetic_leaf
+
+            parts = os.path.basename(
+                os.path.normpath(cfg.data_dir)
+            ).split("_")
+            a = b = None
+            if len(parts) == 3 and parts[0] == "synthetic":
+                try:
+                    a, b = float(parts[1]), float(parts[2])
+                except ValueError:
+                    a = b = None
+            return load_synthetic_leaf(cfg.data_dir, a, b)
+        shapes = {"femnist": ((28, 28, 1), 62), "celeba": ((84, 84, 3), 2)}
         if base not in shapes:
             raise ValueError(
                 f"unsupported LEAF dataset: {base} (numeric-feature LEAF "

@@ -148,6 +148,136 @@ def load_leaf_json(
     )
 
 
+def _fedprox_synthetic_full(alpha: float, beta: float, num_users: int = 30):
+    """Regenerate the FULL FedProx ``synthetic(alpha, beta)`` dataset
+    bit-exactly (reference ``data/synthetic_1_1/generate_synthetic.py``:
+    ``np.random.seed(0)`` drives every draw, so the samples are a pure
+    function of (alpha, beta)). Returns per-user ``(x [n,60] f64,
+    y [n] i32)`` in generation order. Uses the legacy ``np.random.seed``
+    global-state API deliberately — ``default_rng`` draws a different
+    stream and would NOT reproduce the shipped json files."""
+    dimension, num_class = 60, 10
+    np.random.seed(0)
+    samples_per_user = (
+        np.random.lognormal(4, 2, num_users).astype(int) + 50
+    )
+    mean_w = np.random.normal(0, alpha, num_users)
+    b_prior = np.random.normal(0, beta, num_users)
+    cov_x = np.diag(np.arange(1, dimension + 1, dtype=np.float64) ** -1.2)
+    mean_x = np.zeros((num_users, dimension))
+    for i in range(num_users):
+        mean_x[i] = np.random.normal(b_prior[i], 1, dimension)
+    out = []
+    for i in range(num_users):
+        w = np.random.normal(mean_w[i], 1, (dimension, num_class))
+        b = np.random.normal(mean_w[i], 1, num_class)
+        xx = np.random.multivariate_normal(
+            mean_x[i], cov_x, int(samples_per_user[i])
+        )
+        # the reference labels via argmax(softmax(logits)); softmax is
+        # monotonic so argmax(logits) gives identical labels without the
+        # exp (which can overflow for alpha/beta >= 1 logit scales)
+        yy = np.argmax(xx @ w + b, axis=-1).astype(np.int32)
+        out.append((xx, yy))
+    return out
+
+
+def load_synthetic_leaf(
+    data_dir: str, alpha: float | None, beta: float | None
+) -> FederatedData:
+    """The REAL LEAF ``synthetic(alpha, beta)`` files (reference
+    ``data/synthetic_*/``; benchmark row ``benchmark/README.md:14``).
+
+    The reference checkout ships only ``test/mytest.json`` (the 10%
+    split; ``train/mytrain.json`` is a stripped large blob, listed in
+    ``.MISSING_LARGE_BLOBS``). The generator is fully seeded, so the
+    train split is recovered exactly: regenerate the full dataset with
+    the seeded procedure, then remove each user's REAL test rows by row
+    match — the remainder is precisely the content of the missing
+    ``mytrain.json``. Matching tolerates 1-ulp drift (the shipped files
+    were generated under a different LAPACK, whose
+    ``multivariate_normal`` SVD differs in the last bit on ~3% of
+    entries): a rounded-key lookup first, then a nearest-row fallback
+    bounded at 1e-9 max-abs — far below the ~0.1+ spacing of distinct
+    gaussian rows, so a fallback match is unambiguous. When a real
+    ``train/mytrain.json`` IS present it is used directly."""
+    test_p = os.path.join(data_dir, "test", "mytest.json")
+    train_p = os.path.join(data_dir, "train", "mytrain.json")
+    _require(test_p, "synthetic")
+    with open(test_p) as f:
+        test_blob = json.load(f)
+    uids = test_blob["users"]
+    if os.path.exists(train_p):
+        with open(train_p) as f:
+            train_blob = json.load(f)
+        train = [
+            (
+                np.asarray(train_blob["user_data"][u]["x"], np.float64),
+                np.asarray(train_blob["user_data"][u]["y"], np.int32),
+            )
+            for u in uids
+        ]
+    else:
+        if alpha is None or beta is None:
+            raise ValueError(
+                f"{data_dir}: train/mytrain.json is absent, so the train "
+                "split must be reconstructed from the seeded generator — "
+                "that needs (alpha, beta), which could not be parsed "
+                "from the directory name (expected synthetic_<a>_<b>)"
+            )
+        full = _fedprox_synthetic_full(alpha, beta, len(uids))
+        train = []
+        for i, u in enumerate(uids):
+            fx, fy = full[i]
+            tx = np.asarray(test_blob["user_data"][u]["x"], np.float64)
+            # multiset row match: every real test row must be found in
+            # the regenerated user data, else the files are not the
+            # seeded generation we assume — fail loudly, never guess
+            pool: dict[bytes, list[int]] = {}
+            for j, row in enumerate(fx):
+                pool.setdefault(np.round(row, 8).tobytes(), []).append(j)
+            held_out: set[int] = set()
+            for row in tx:
+                cands = pool.get(np.round(row, 8).tobytes())
+                while cands:  # skip indices claimed via the fallback
+                    if cands[-1] not in held_out:
+                        break
+                    cands.pop()
+                if cands:
+                    held_out.add(cands.pop())
+                    continue
+                # 1-ulp drift across a rounding boundary: nearest row
+                err = np.abs(fx - row).max(axis=1)
+                err[list(held_out)] = np.inf
+                j = int(err.argmin())
+                if err[j] > 1e-9:
+                    raise ValueError(
+                        f"{test_p}: user {u} test row not found in the "
+                        "seeded regeneration (nearest max-abs diff "
+                        f"{err[j]:.3g}) — files do not match the "
+                        "FedProx generator output"
+                    )
+                held_out.add(j)
+            keep = np.array(
+                [j for j in range(len(fx)) if j not in held_out], np.int64
+            )
+            train.append((fx[keep], fy[keep]))
+    test = [
+        (
+            np.asarray(test_blob["user_data"][u]["x"], np.float64),
+            np.asarray(test_blob["user_data"][u]["y"], np.int32),
+        )
+        for u in uids
+    ]
+    x_tr, y_tr, tr_map = _natural_maps(
+        [(x.astype(np.float32), y) for x, y in train]
+    )
+    x_te, y_te, te_map = _natural_maps(
+        [(x.astype(np.float32), y) for x, y in test]
+    )
+    return FederatedData(x_tr, y_tr, x_te, y_te, tr_map, te_map, 10)
+
+
 def _leaf_text_to_arrays(xs: list, ys: list):
     """LEAF shakespeare text rows -> (tokens [n, L], next-char [n, L])
     shifted LM targets: the context window is tokenized with the shared
