@@ -153,25 +153,25 @@ def _fedprox_synthetic_full(alpha: float, beta: float, num_users: int = 30):
     bit-exactly (reference ``data/synthetic_1_1/generate_synthetic.py``:
     ``np.random.seed(0)`` drives every draw, so the samples are a pure
     function of (alpha, beta)). Returns per-user ``(x [n,60] f64,
-    y [n] i32)`` in generation order. Uses the legacy ``np.random.seed``
-    global-state API deliberately — ``default_rng`` draws a different
-    stream and would NOT reproduce the shipped json files."""
+    y [n] i32)`` in generation order. Uses a legacy ``RandomState(0)``
+    deliberately — it draws the same stream as the generator's
+    ``np.random.seed(0)`` without clobbering the caller's global numpy
+    RNG state (``default_rng`` draws a different stream and would NOT
+    reproduce the shipped json files)."""
     dimension, num_class = 60, 10
-    np.random.seed(0)
-    samples_per_user = (
-        np.random.lognormal(4, 2, num_users).astype(int) + 50
-    )
-    mean_w = np.random.normal(0, alpha, num_users)
-    b_prior = np.random.normal(0, beta, num_users)
+    rs = np.random.RandomState(0)
+    samples_per_user = rs.lognormal(4, 2, num_users).astype(int) + 50
+    mean_w = rs.normal(0, alpha, num_users)
+    b_prior = rs.normal(0, beta, num_users)
     cov_x = np.diag(np.arange(1, dimension + 1, dtype=np.float64) ** -1.2)
     mean_x = np.zeros((num_users, dimension))
     for i in range(num_users):
-        mean_x[i] = np.random.normal(b_prior[i], 1, dimension)
+        mean_x[i] = rs.normal(b_prior[i], 1, dimension)
     out = []
     for i in range(num_users):
-        w = np.random.normal(mean_w[i], 1, (dimension, num_class))
-        b = np.random.normal(mean_w[i], 1, num_class)
-        xx = np.random.multivariate_normal(
+        w = rs.normal(mean_w[i], 1, (dimension, num_class))
+        b = rs.normal(mean_w[i], 1, num_class)
+        xx = rs.multivariate_normal(
             mean_x[i], cov_x, int(samples_per_user[i])
         )
         # the reference labels via argmax(softmax(logits)); softmax is
