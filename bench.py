@@ -17,17 +17,26 @@ supplementary fields:
   and its fraction of the chip's bf16 peak. Useful-work MFU is
   intentionally conservative: cohort-lockstep padding and XLA's
   dense expansion of grouped convolutions are charged against it.
-- ``hbm_util``: same useful-work accounting against peak HBM bandwidth.
-  The bytes numerator is XLA's static "bytes accessed" for ONE
-  training step; values above 1.0 mean the executed round moves fewer
-  bytes than that model charges (XLA fusion eliminating intermediate
-  traffic) — an accounting artifact, not a physics violation.
-  At ResNet-56's CIFAR channel widths (16-64 per client) per-client
-  convolutions cannot tile the 128x128 MXU, so the round is
-  bandwidth/lowering-bound, not FLOP-bound; the round program (cohort-
-  grouped network, fedml_tpu.models.cohort) is the measured-fastest of
-  the lowerings tried (vmapped batched-kernel convs, per-op grouped
-  rewrites, im2col batched matmuls).
+- ``hbm_util``: COMPULSORY-traffic lower bound against peak HBM
+  bandwidth — the bytes the round semantics force across HBM (cohort
+  model+optimizer state in and out once per round, the global model
+  broadcast, and every training batch read once per epoch), times the
+  measured round rate. It is ``<= 1`` by construction (a lower bound on
+  physical traffic over an interval cannot exceed bandwidth x time) and
+  usually SMALL — which is the finding, not a bug: r3 published a
+  scheduled-traffic model here and got 1.16, i.e. XLA's per-step "bytes
+  accessed" x executed steps exceeds what the chip can physically move.
+  The resolution (verified against the compiled round executable's own
+  cost analysis, whose per-client-step bytes match the single-step
+  model within 2%) is that the loop-carried cohort state stays resident
+  in on-chip memory across SGD steps instead of round-tripping HBM.
+  The round is therefore NOT bandwidth-bound at these model sizes: at
+  ResNet-56's CIFAR channel widths (16-64 per client) it is bound by
+  conv *lowering latency* on the 128x128 MXU (see mfu), which is
+  exactly why the cohort-grouped/s2d layouts win. The round program
+  (fedml_tpu.models.cohort) is the measured-fastest of the lowerings
+  tried (vmapped batched-kernel convs, per-op grouped rewrites, im2col
+  batched matmuls).
 
 ``vs_baseline`` compares against the reference implementation's achievable
 round rate on this host: FedML's standalone simulator trains sampled clients
@@ -102,21 +111,16 @@ def build_sim(num_clients=100, full_cifar=False, model_name="resnet56"):
         seed=0,
     )
     if full_cifar:
-        # north-star shape: full CIFAR-10 size (50k train), synthesized
-        # (the bench host is offline; shapes/partition are what matter)
-        from fedml_tpu.data.federated import build_federated_data
+        # north-star shape: full CIFAR-10 size (50k train / 10k test),
+        # non-IID alpha=0.5, LEARNABLE procedural stand-in (class
+        # prototypes + noise — real CIFAR files are not on the offline
+        # bench host, so real-CIFAR 80% is unverifiable here; the
+        # stand-in carries both the rate line and time-to-accuracy at
+        # the full 1000c/50k scale)
+        from fedml_tpu.data.loaders import make_fake_image_dataset
 
-        rng = np.random.default_rng(0)
-        data = build_federated_data(
-            rng.random((50000, 32, 32, 3), np.float32),
-            rng.integers(0, 10, 50000).astype(np.int64),
-            rng.random((10000, 32, 32, 3), np.float32),
-            rng.integers(0, 10, 10000).astype(np.int64),
-            10,
-            num_clients,
-            partition_method="hetero",
-            alpha=0.5,
-            seed=0,
+        data = make_fake_image_dataset(
+            "cifar10", cfg.data, n_train=50000, n_test=10000
         )
     else:
         data = load_dataset(cfg.data)
@@ -344,12 +348,19 @@ def torch_baseline_round_seconds(
     steps_per_client: float,
     clients_per_round: int,
     batch_size: int = 32,
-) -> float:
+) -> tuple[float, float]:
     """Per-round wall-clock of the reference-style serial torch loop
     (``fedml_api/standalone/fedavg/fedavg_api.py:40-81``: sampled clients
-    train one after another), extrapolated from a few timed fwd+bwd
-    batches of the family's torch model. Timing policy mirrors the
-    framework side: best of 3 windows (symmetric estimator)."""
+    train one after another). Returns ``(extrapolated_s, anchor_s)``:
+
+    - ``extrapolated_s``: best-of-3-windows per-batch time x total
+      batches — the SAME estimator policy as the framework side, so
+      vs_baseline compares like to like.
+    - ``anchor_s``: ONE fully MEASURED serial round — every batch of
+      every sampled client actually executed in a single timed pass
+      (VERDICT r3 weak 5: the headline ratio deserves a measured
+      anchor, not only an extrapolation). ``vs_baseline`` uses this.
+    """
     import torch
 
     net, x, y, lossf = _TORCH_BUILDERS[torch_kind](batch_size)
@@ -361,8 +372,6 @@ def torch_baseline_round_seconds(
         opt.step()
 
     step()  # warmup
-    # best of 3 windows of 2 steps — the SAME estimator policy as the
-    # framework side, so vs_baseline compares like to like
     best = None
     for _ in range(3):
         t0 = time.perf_counter()
@@ -370,21 +379,40 @@ def torch_baseline_round_seconds(
             step()
         per_batch = (time.perf_counter() - t0) / 2
         best = per_batch if best is None else min(best, per_batch)
-    return best * steps_per_client * clients_per_round
+    extrap = best * steps_per_client * clients_per_round
+    total_batches = max(1, int(round(steps_per_client * clients_per_round)))
+
+    def full_pass():
+        t0 = time.perf_counter()
+        for _ in range(total_batches):
+            step()
+        return time.perf_counter() - t0
+
+    anchor = full_pass()
+    # stall guard: the TPU side rejects transient host stalls via
+    # best-of-3 windows; give the anchor the same protection only when
+    # it looks stalled (>1.5x the extrapolation), keeping the common
+    # case one pass
+    if anchor > 1.5 * extrap:
+        anchor = min(anchor, full_pass())
+    return extrap, anchor
 
 
 _COST_CACHE: dict = {}
 
 
 def useful_round_cost(sim):
-    """Analytic (flops, bytes) of the USEFUL work in one round: sampled
-    clients x their real serial-equivalent optimizer steps x one
-    fwd+bwd batch. The compiled round's own XLA cost analysis is no
-    longer meaningful — the step loop has a data-dependent trip count
-    (padding steps are skipped at runtime), which the static cost model
-    cannot see — so MFU is reported against the work the *semantics*
-    require, making it an honest utilization number: padding waste and
-    grouped-conv expansion lower it, exactly as they should."""
+    """Analytic FLOPs of the USEFUL work in one round: sampled clients
+    x their real serial-equivalent optimizer steps x one fwd+bwd batch.
+    The compiled round's own XLA cost analysis is not usable directly —
+    the step loop has a data-dependent trip count (padding steps are
+    skipped at runtime) and HLO cost analysis counts loop bodies once —
+    so MFU is reported against the work the *semantics* require, making
+    it an honest utilization number: padding waste and grouped-conv
+    expansion lower it, exactly as they should. (Bytes moved are
+    handled separately by :func:`compulsory_round_bytes`; the per-step
+    "bytes accessed" model this function used through r3 produced
+    utilizations > 1 and is retired — see the module docstring.)"""
     import jax
     import jax.numpy as jnp
     import optax
@@ -420,7 +448,7 @@ def useful_round_cost(sim):
     y_shape = (B,) + sim.arrays.y.shape[1:]
     cost_key = (sim.cfg.model.name, x_shape, y_shape, str(compute_dtype))
     if cost_key in _COST_CACHE:
-        step_flops, step_bytes = _COST_CACHE[cost_key]
+        step_flops = _COST_CACHE[cost_key]
     else:
         variables = model.init(jax.random.key(0))
         params = variables["params"]
@@ -437,16 +465,52 @@ def useful_round_cost(sim):
             if isinstance(ca, list):
                 ca = ca[0]
             step_flops = float(ca.get("flops") or 0) or None
-            step_bytes = float(ca.get("bytes accessed") or 0) or None
         except Exception:
-            return None, None
-        _COST_CACHE[cost_key] = (step_flops, step_bytes)
+            return None
+        _COST_CACHE[cost_key] = step_flops
     counts = np.asarray(sim.arrays.counts)
     mean_steps = float(np.mean(np.ceil(counts / B)))
     k = sim.cfg.fed.clients_per_round * mean_steps * sim.cfg.train.epochs
+    return step_flops * k if step_flops else None
+
+
+def compulsory_round_bytes(sim) -> float:
+    """Lower bound on the HBM traffic one round MUST move (the
+    ``hbm_util`` numerator — see the module docstring): the sampled
+    cohort's stacked model+optimizer state written out and read back
+    once per round (client update out, aggregation in), the global
+    model broadcast to the cohort, and every executed training batch
+    read once. On-chip-resident loop state, fused intermediates and any
+    re-reads are deliberately NOT charged — this is the compulsory
+    floor, so utilization is a true lower bound."""
+    import jax
+
+    def tree_bytes(t):
+        return float(
+            sum(np.prod(x.shape) * x.dtype.itemsize
+                for x in jax.tree.leaves(t))
+        )
+
+    # shapes/dtypes only — no device allocation for accounting
+    state = jax.eval_shape(sim.init)
+    # per-client trained state: model variables (+ sgd momentum if
+    # configured — plain sgd carries none)
+    var_bytes = tree_bytes(state.variables)
+    mom = getattr(sim.cfg.train, "momentum", 0.0)
+    client_state = var_bytes * (2.0 if mom else 1.0)
+    cohort = sim.cfg.fed.clients_per_round
+    counts = np.asarray(sim.arrays.counts)
+    mean_steps = float(np.mean(np.ceil(counts / sim.batch_size)))
+    batch_bytes = float(
+        sim.batch_size * np.prod(sim.arrays.x.shape[1:])
+        * sim.arrays.x.dtype.itemsize
+        + sim.batch_size * np.prod(sim.arrays.y.shape[1:] or (1,))
+        * sim.arrays.y.dtype.itemsize
+    )
     return (
-        step_flops * k if step_flops else None,
-        step_bytes * k if step_bytes else None,
+        2.0 * cohort * client_state  # cohort state out + in
+        + var_bytes  # global broadcast
+        + cohort * mean_steps * sim.cfg.train.epochs * batch_bytes
     )
 
 
@@ -486,7 +550,7 @@ def _compiled_round(sim, cache: bool = False):
         if cache:
             sim._bench_cached_round = run_round
     state, _ = run_round(state)  # warmup (execute once)
-    jax.block_until_ready(state.variables)
+    jax.block_until_ready(jax.tree.leaves(state))
     return run_round, state
 
 
@@ -525,7 +589,9 @@ def rate_bench(sim, rounds: int, cache: bool = False):
         t0 = time.perf_counter()
         for _ in range(size):
             state, m = run_round(state)
-        float(np.asarray(jax.device_get(m["train_loss"])))
+        # sync on a round-output scalar (any metric works; device_get is
+        # the only reliable sync on the tunnelled backend)
+        float(np.asarray(jax.device_get(next(iter(m.values())))))
         wall = time.perf_counter() - t0
         dt = max(wall - fetch_cost, wall / 2)
         rates.append(size / dt)
@@ -537,7 +603,8 @@ def rate_record(sim, metric: str, rounds: int, torch_kind: str | None,
     import jax
 
     rps, rps_median, rates = rate_bench(sim, rounds, cache=cache)
-    flops, bbytes = useful_round_cost(sim)
+    flops = useful_round_cost(sim)
+    bbytes = compulsory_round_bytes(sim)
     kind = jax.devices()[0].device_kind
     peak_flops, peak_bw = PEAKS.get(kind, (None, None))
     delivered = flops * rps if flops else None
@@ -545,6 +612,7 @@ def rate_record(sim, metric: str, rounds: int, torch_kind: str | None,
     hbm = bbytes * rps / peak_bw if bbytes and peak_bw else None
 
     vs = float("nan")
+    anchor_s = extrap_s = None
     if not skip_torch and torch_kind is not None:
         # the reference serial loop runs ceil(n_k/B) real batches per
         # sampled client — use the mean over clients, NOT the padded max.
@@ -554,11 +622,11 @@ def rate_record(sim, metric: str, rounds: int, torch_kind: str | None,
         steps_per_client = float(
             np.mean(np.ceil(counts / sim.batch_size))
         ) * sim.cfg.train.epochs
-        base_round_s = torch_baseline_round_seconds(
+        extrap_s, anchor_s = torch_baseline_round_seconds(
             torch_kind, steps_per_client, sim.cfg.fed.clients_per_round,
             batch_size=sim.batch_size,
         )
-        vs = rps * base_round_s  # ratio of round rates
+        vs = rps * anchor_s  # ratio of round rates, measured anchor
     return {
         "metric": metric,
         "value": round(rps, 4),
@@ -570,16 +638,24 @@ def rate_record(sim, metric: str, rounds: int, torch_kind: str | None,
         else None,
         "mfu": round(mfu, 4) if mfu else None,
         "hbm_util": round(hbm, 4) if hbm else None,
+        "baseline_anchor_s": (
+            round(anchor_s, 3) if anchor_s is not None else None
+        ),
+        "baseline_extrapolated_s": (
+            round(extrap_s, 3) if extrap_s is not None else None
+        ),
         "device": kind,
     }
 
 
-def time_to_acc_record(sim, model_name: str, target: float,
+def time_to_acc_record(sim, label: str, target: float,
                        max_rounds: int, cache: bool = False) -> dict:
     """Wall-clock (and rounds) to reach ``target`` test accuracy — the
     convergence-speed evidence behind the north-star claim, on the
-    LEARNABLE procedural CIFAR stand-in (class prototypes + noise; real
-    CIFAR files are not on the offline bench host)."""
+    LEARNABLE procedural CIFAR stand-in (class prototypes + noise).
+    ``label`` must name the dataset SCALE (clients/samples) so the
+    metric says what was measured; real-CIFAR 80% remains unverifiable
+    on the offline bench host and no line claims it."""
     run_round, state = _compiled_round(sim, cache=cache)
     sim.evaluate_global(state)  # warm the evaluator compile before t0
     t0 = time.perf_counter()
@@ -593,7 +669,7 @@ def time_to_acc_record(sim, model_name: str, target: float,
                 rounds_used = r + 1
                 break
     return {
-        "metric": f"time_to_{target}_acc_{model_name}",
+        "metric": f"time_to_{target}_acc_{label}",
         "value": round(reached, 2) if reached else None,
         "unit": "seconds",
         "vs_baseline": None,
@@ -725,6 +801,202 @@ def family_rate_record(fam: str, rounds: int, skip_torch: bool) -> dict:
                        skip_torch)
 
 
+# ---------------------------------------------------------------------------
+# FedGDKD (the fork's flagship) — rounds/sec at the reference battery
+# shape (Makefile:5-13 / run_fed_experiment.sh: MNIST, 10 clients all
+# participating, hetero alpha=0.1, r=0.1 -> 6000 samples, 5 epochs,
+# batch 32, cnn_medium + conditional generator). The reference's
+# headline cost is the ~20 h battery (FedGDKD_README.md:10).
+# ---------------------------------------------------------------------------
+
+
+def build_fedgdkd_sim():
+    from fedml_tpu.config import (
+        DataConfig, ExperimentConfig, FedConfig, GanConfig, ModelConfig,
+        TrainConfig,
+    )
+    from fedml_tpu.algorithms.gan_family import FedGDKDSim
+    from fedml_tpu.data.loaders import make_fake_image_dataset
+    from fedml_tpu.models import create_model
+    from fedml_tpu.models.gan import generator_from_config
+
+    cfg = ExperimentConfig(
+        data=DataConfig(dataset="fake_mnist", num_clients=10,
+                        partition_method="hetero", partition_alpha=0.1,
+                        batch_size=32, seed=0),
+        model=ModelConfig(name="cnn_medium", num_classes=10,
+                          input_shape=(28, 28, 1)),
+        # GAN numerics stay f32 (adversarial training is the part of the
+        # suite most sensitive to reduced precision)
+        train=TrainConfig(lr=0.03, epochs=5),
+        fed=FedConfig(num_rounds=1000, clients_per_round=10,
+                      eval_every=10**9),
+        gan=GanConfig(),  # distillation_size 1024 (static-shape default)
+        seed=0,
+    )
+    data = make_fake_image_dataset("mnist", cfg.data, n_train=6000)
+    gen = generator_from_config(cfg.gan, 10, 28, 1)
+    return FedGDKDSim(gen, create_model(cfg.model), data, cfg)
+
+
+def torch_fedgdkd_round_seconds(
+    steps_per_client: float, clients: int, synth_size: int,
+    kd_epochs: int, batch_size: int = 32,
+) -> tuple[float, float]:
+    """Serial-torch wall-clock of ONE FedGDKD round with the same
+    structure the reference executes (``standalone/fedgdkd/server.py:
+    70-165``): per client adversarial G+D training over its batches,
+    then generate the distillation set from the averaged generator, then
+    per client logit extraction + KD over the synthetic set. Component
+    costs are measured (best-of-3 like the framework side) and composed
+    by count."""
+    import torch
+    import torch.nn as nn
+
+    class CondGen(nn.Module):
+        """Mirror of ConditionalImageGenerator at MNIST shape: label
+        embedding x z -> dense 128*7*7 -> ConvT(64) -> BN -> relu ->
+        ConvT(1) -> tanh."""
+
+        def __init__(self):
+            super().__init__()
+            self.emb = nn.Embedding(10, 100)
+            self.l1 = nn.Linear(100, 128 * 7 * 7)
+            self.body = nn.Sequential(
+                nn.ConvTranspose2d(128, 64, 4, 2, 1, bias=False),
+                nn.BatchNorm2d(64), nn.ReLU(),
+                nn.ConvTranspose2d(64, 1, 4, 2, 1, bias=False), nn.Tanh(),
+            )
+
+        def forward(self, z, y):
+            h = self.l1(z * self.emb(y)).view(-1, 128, 7, 7)
+            return self.body(h)
+
+    # cnn_medium classifier (convs (32, 64), dense (128))
+    cls = nn.Sequential(
+        nn.Conv2d(1, 32, 3, padding=1), nn.ReLU(), nn.MaxPool2d(2),
+        nn.Conv2d(32, 64, 3, padding=1), nn.ReLU(), nn.MaxPool2d(2),
+        nn.Flatten(), nn.Linear(64 * 7 * 7, 128), nn.ReLU(),
+        nn.Linear(128, 10),
+    )
+    gen = CondGen()
+    g_opt = torch.optim.Adam(gen.parameters(), lr=1e-3)
+    c_opt = torch.optim.SGD(cls.parameters(), lr=0.03)
+    ce = nn.CrossEntropyLoss()
+    B = batch_size
+    x = torch.randn(B, 1, 28, 28)
+    y = torch.randint(0, 10, (B,))
+    z = torch.randn(B, 100)
+
+    def timed(fn, reps=2):
+        fn()  # warmup
+        best = None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                fn()
+            dt = (time.perf_counter() - t0) / reps
+            best = dt if best is None else min(best, dt)
+        return best
+
+    def gan_step():
+        # D on real + fake, then G through D (reference
+        # model_trainer.py:23-113 adversarial losses)
+        c_opt.zero_grad()
+        fake = gen(z, y)
+        (ce(cls(x), y) + ce(cls(fake.detach()), y)).backward()
+        c_opt.step()
+        g_opt.zero_grad()
+        ce(cls(gen(z, y)), y).backward()
+        g_opt.step()
+
+    def synth_batch():
+        with torch.no_grad():
+            gen(z, y)
+
+    def extract_batch():
+        with torch.no_grad():
+            cls(x)
+
+    def kd_batch():
+        c_opt.zero_grad()
+        ce(cls(x), y).backward()
+        c_opt.step()
+
+    t_gan = timed(gan_step)
+    t_synth = timed(synth_batch)
+    t_extract = timed(extract_batch)
+    t_kd = timed(kd_batch)
+    synth_batches = synth_size / B
+    extrap = (
+        clients * steps_per_client * t_gan
+        + synth_batches * t_synth
+        + clients * synth_batches * t_extract
+        + clients * kd_epochs * synth_batches * t_kd
+    )
+    # one fully MEASURED serial round (the anchor): execute the whole
+    # reference flow batch by batch
+    sb = int(np.ceil(synth_batches))
+
+    def full_pass():
+        t0 = time.perf_counter()
+        for _ in range(clients):
+            for _ in range(int(round(steps_per_client))):
+                gan_step()
+        for _ in range(sb):
+            synth_batch()
+        for _ in range(clients):
+            for _ in range(sb):
+                extract_batch()
+        for _ in range(clients):
+            for _ in range(kd_epochs * sb):
+                kd_batch()
+        return time.perf_counter() - t0
+
+    anchor = full_pass()
+    if anchor > 1.5 * extrap:  # stall guard (same policy as rate lines)
+        anchor = min(anchor, full_pass())
+    return extrap, anchor
+
+
+def fedgdkd_record(rounds: int, skip_torch: bool) -> dict:
+    import jax
+
+    sim = build_fedgdkd_sim()
+    # GAN rounds are ~1.4 s each; 15 rounds (3 windows of 5) keeps the
+    # suite affordable and the ~110 ms fetch correction is <2% of a
+    # window at this round cost (vs the 30%-error regime of fast rounds)
+    rps, rps_median, rates = rate_bench(sim, min(rounds, 15))
+    vs = float("nan")
+    anchor_s = extrap_s = None
+    if not skip_torch:
+        counts = np.asarray(sim.arrays.counts)
+        steps = float(
+            np.mean(np.ceil(counts / sim.batch_size))
+        ) * sim.cfg.train.epochs
+        extrap_s, anchor_s = torch_fedgdkd_round_seconds(
+            steps, sim.cfg.fed.clients_per_round, sim.synth_size,
+            sim.cfg.gan.kd_epochs, sim.batch_size,
+        )
+        vs = rps * anchor_s
+    return {
+        "metric": "fedgdkd_rounds_per_sec_10c_mnist_cnn_medium",
+        "value": round(rps, 4),
+        "unit": "rounds/sec",
+        "vs_baseline": round(vs, 2) if np.isfinite(vs) else None,
+        "value_median": round(rps_median, 4),
+        "window_rates": [round(r, 4) for r in rates],
+        "synth_size": sim.synth_size,
+        "baseline_anchor_s": (
+            round(anchor_s, 3) if anchor_s is not None else None
+        ),
+        "baseline_extrapolated_s": (
+            round(extrap_s, 3) if extrap_s is not None else None
+        ),
+        "device": jax.devices()[0].device_kind,
+    }
+
+
 REFERENCE_SYNTH_DIR = "/root/reference/data/synthetic_1_1"
 
 
@@ -830,6 +1102,8 @@ def main():
                     help="ONLY the real-LEAF synthetic(1,1) accuracy row")
     ap.add_argument("--family", choices=sorted(FAMILY_SPECS),
                     help="ONLY this BASELINE config-family rate line")
+    ap.add_argument("--fedgdkd", action="store_true",
+                    help="ONLY the FedGDKD flagship rate line")
     args = ap.parse_args()
 
     _enable_compile_cache()
@@ -853,10 +1127,19 @@ def main():
         emit(family_rate_record(args.family, args.rounds,
                                 args.skip_torch_baseline))
         return
+    if args.fedgdkd:
+        emit(fedgdkd_record(args.rounds, args.skip_torch_baseline))
+        return
     if args.target_acc is not None:
-        model_name = "resnet56_s2d" if args.s2d else "resnet56"
-        sim, _ = build_sim(model_name=model_name)
-        emit(time_to_acc_record(sim, model_name, args.target_acc,
+        model_name = "resnet56" if args.std else "resnet56_s2d"
+        if args.northstar:  # composes: tta at the north-star scale
+            sim, _ = build_sim(num_clients=1000, full_cifar=True,
+                               model_name=model_name)
+            label = f"1000c_50k_noniid_cifar10_{model_name}"
+        else:
+            sim, _ = build_sim(model_name=model_name)
+            label = f"100c_6k_cifar10_{model_name}"
+        emit(time_to_acc_record(sim, label, args.target_acc,
                                 args.max_rounds))
         return
     if args.northstar or args.s2d or args.std:
@@ -890,6 +1173,11 @@ def main():
         except Exception as err:  # one family must not sink the suite
             print(f"[bench] family {fam} failed: {err}", file=sys.stderr,
                   flush=True)
+    try:
+        emit(fedgdkd_record(args.rounds, args.skip_torch_baseline))
+    except Exception as err:
+        print(f"[bench] fedgdkd failed: {err}", file=sys.stderr,
+              flush=True)
     sim, _ = build_sim(model_name="resnet56")
     emit(rate_record(
         sim, "fedavg_rounds_per_sec_100c_cifar10_resnet56",
@@ -898,19 +1186,24 @@ def main():
     del sim
     ns, _ = build_sim(num_clients=1000, full_cifar=True,
                       model_name="resnet56_s2d")
+    # time-to-accuracy AT THE NORTH-STAR SCALE (1000 clients, 50k
+    # samples, non-IID alpha=0.5), sharing one sim+executable with the
+    # north-star rate line (VERDICT r3 item 5)
+    emit(time_to_acc_record(
+        ns, "1000c_50k_noniid_cifar10_resnet56_s2d", 0.8, 2000,
+        cache=True,
+    ))
     emit(rate_record(
         ns, "fedavg_rounds_per_sec_1000c_noniid_cifar10_resnet56_s2d",
-        args.rounds, "resnet56_s2d", args.skip_torch_baseline,
+        args.rounds, "resnet56_s2d", args.skip_torch_baseline, cache=True,
     ))
     del ns
     s2d_sim, _ = build_sim(model_name="resnet56_s2d")
-    emit(time_to_acc_record(s2d_sim, "resnet56_s2d", 0.8, 1000,
-                            cache=True))
     emit(rate_record(
         s2d_sim, "fedavg_rounds_per_sec_100c_cifar10_resnet56_s2d",
-        args.rounds, "resnet56_s2d", args.skip_torch_baseline, cache=True,
+        args.rounds, "resnet56_s2d", args.skip_torch_baseline,
     ))
-    del s2d_sim  # frees the cached compiled round with it
+    del s2d_sim
 
 
 if __name__ == "__main__":
