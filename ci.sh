@@ -30,6 +30,15 @@ echo "== 2/3 smoke matrix (tiny runs) =="
 python scripts/smoke_matrix.py "$OUT/smoke"
 
 if [ "${1:-}" = "full" ]; then
+  # the ENTIRE slow tier (GAN/NAS/attention + heavy equality suites —
+  # 36% of the suite; VERDICT r3 weak 6: it must have a cadence, not
+  # depend on someone remembering `-m slow`). Wall-clock printed so the
+  # cost stays visible in round notes.
+  echo "== full mode: slow test tier =="
+  SLOW_T0=$(date +%s)
+  python -m pytest tests -m slow -q -p no:cacheprovider
+  echo "slow tier passed in $(( $(date +%s) - SLOW_T0 ))s."
+
   # slow-compiling batteries, mirroring the reference's separate
   # CI-script-fednas.sh (several minutes of XLA compile on CPU)
   echo "  -- fednas search (full mode)"

@@ -402,6 +402,11 @@ class GKTServerActor(ServerManager):
         self.round_idx = 0
         self.done = threading.Event()
         self._banks: dict[int, dict] = {}
+        # test/diagnostic hook: called with (round_idx, f_banks, l_banks,
+        # y_banks) once per round, right before the server phase consumes
+        # the assembled banks — lets equality tests pin the ACTOR-produced
+        # banks against sim-produced banks per phase
+        self.on_banks = None
         self.server_logits = jnp.zeros(
             (sim.n_total, sim.num_classes)
         )
@@ -555,6 +560,8 @@ class GKTServerActor(ServerManager):
             stack("features"), stack("logits"), stack("labels"),
             stack("mask"),
         )
+        if self.on_banks is not None:
+            self.on_banks(self.round_idx, f_banks, l_banks, y_banks)
         (self.server_vars, self.server_opt_state,
          self.server_logits) = self._server_phase(
             self.server_vars, self.server_opt_state,
@@ -822,14 +829,17 @@ def run_splitnn_distributed(
 
 
 def run_gkt_distributed(
-    sim, transports: Sequence[BaseTransport], init_state
+    sim, transports: Sequence[BaseTransport], init_state, on_banks=None
 ):
     """Run FedGKT actors from a ``FedGKTSim`` (used for its jitted phase
-    math and config) and its init state; returns the server actor."""
+    math and config) and its init state; returns the server actor.
+    ``on_banks`` (optional) is installed as the server's per-round bank
+    capture hook."""
     size = len(transports)
     server = GKTServerActor(
         size, transports[0], sim, init_state.server_vars
     )
+    server.on_banks = on_banks
     clients = [
         GKTClientActor(
             r, size, transports[r], sim,
