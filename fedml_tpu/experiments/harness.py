@@ -421,9 +421,14 @@ class Experiment:
                     if hasattr(sim, ev_name):
                         ev = getattr(sim, ev_name)(state) if state is not \
                             None else getattr(sim, ev_name)()
+                        # evaluators on a TEST split return bare
+                        # {acc, loss}; normalize to the test_* names the
+                        # summary consumers (battery table, wandb
+                        # groupings) key on
+                        rename = {"acc": "test_acc", "loss": "test_loss"}
                         record.update(
-                            {k: _f(v) for k, v in ev.items()
-                             if _scalar(v)}
+                            {rename.get(k, k): _f(v)
+                             for k, v in ev.items() if _scalar(v)}
                         )
                         break
             sink.log(record)

@@ -50,7 +50,9 @@ def battery_config(algorithm: str, rounds: int, epochs: int, out_dir: str):
             # .json: cnn_medium everywhere)
             name="cnn_medium", num_classes=10, input_shape=(28, 28, 1),
         ),
-        train=TrainConfig(lr=0.03, epochs=epochs),
+        # reference battery client-optimizer defaults
+        # (standalone/utils/config.py:31-37: sgd, lr 0.01, wd 0.001)
+        train=TrainConfig(lr=0.01, weight_decay=1e-3, epochs=epochs),
         fed=FedConfig(
             algorithm=algorithm, num_rounds=rounds,
             clients_per_round=10, eval_every=10,
@@ -91,8 +93,12 @@ def main():
             t0 = time.perf_counter()
             try:
                 summaries = Experiment(cfg, repetitions=args.reps).run()
-            except Exception as err:  # one algorithm must not sink the
+            except Exception as err:  # one algorithm must not sink
                 print(f"[battery] {algo} FAILED: {err}", flush=True)
+                jf.write(json.dumps(
+                    {"algorithm": algo, "failed": str(err)}
+                ) + "\n")
+                jf.flush()
                 rows.append((algo, 0, float("nan"), float("nan"),
                              time.perf_counter() - t0))
                 continue
@@ -112,10 +118,13 @@ def main():
                 (sum((a - mean) ** 2 for a in accs) / len(accs)) ** 0.5
                 if accs else float("nan")
             )
-            rows.append((algo, len(summaries), mean, std, wall))
+            # reps with a test_acc in their summary (some sims emit
+            # other final metrics, e.g. online DSGD's regret)
+            rows.append((algo, len(accs), mean, std, wall))
             print(
                 f"[battery] {algo}: test_acc {mean:.4f} +- {std:.4f} "
-                f"({len(accs)} reps, {wall:.0f}s)", flush=True,
+                f"({len(accs)}/{len(summaries)} reps with test_acc, "
+                f"{wall:.0f}s)", flush=True,
             )
 
     print(f"\nBattery summary ({args.reps} reps x {args.rounds} rounds, "
