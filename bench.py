@@ -827,8 +827,11 @@ def build_fedgdkd_sim():
         model=ModelConfig(name="cnn_medium", num_classes=10,
                           input_shape=(28, 28, 1)),
         # GAN numerics stay f32 (adversarial training is the part of the
-        # suite most sensitive to reduced precision)
-        train=TrainConfig(lr=0.03, epochs=5),
+        # suite most sensitive to reduced precision). cohort_groups=5:
+        # size-sorted sub-groups of 2 for the vmapped GAN phase —
+        # measured 0.70 -> 0.93 (auto 2 groups) -> 1.19 rounds/s
+        # (5 groups) on v5e, same lever as the classification headline
+        train=TrainConfig(lr=0.03, epochs=5, cohort_groups=5),
         fed=FedConfig(num_rounds=1000, clients_per_round=10,
                       eval_every=10**9),
         gan=GanConfig(),  # distillation_size 1024 (static-shape default)

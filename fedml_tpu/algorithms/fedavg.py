@@ -182,21 +182,13 @@ def server_update(
     )
 
 
-def _resolve_cohort_groups(requested: int, cohort: int) -> int:
-    """Number of size-sorted sub-groups the fused cohort runs in.
-    ``requested`` is capped at cohort // 2 (a group needs >= 2 clients)
-    and rounded DOWN to the nearest divisor of the cohort (static shapes
-    need equal groups); 0 = auto. Auto uses groups of ~5 clients:
-    measured on v5e the fat model's cost scales linearly down to C=5,
-    and per-group trip counts at that size already capture most of the
-    padding-waste reduction (see TrainConfig.cohort_groups)."""
-    if cohort <= 2:
-        return 1
-    want = requested if requested > 0 else max(1, round(cohort / 5))
-    want = max(1, min(want, cohort // 2))
-    while cohort % want:
-        want -= 1
-    return want
+# canonical implementations live in stack_utils (shared with the GAN
+# family's vmapped path); re-exported here for the established import
+# path
+from fedml_tpu.algorithms.stack_utils import (  # noqa: E402
+    resolve_cohort_groups as _resolve_cohort_groups,
+    size_grouped_lanes as _size_grouped_lanes,
+)
 
 
 def _grouped_cohort_call(
@@ -209,28 +201,17 @@ def _grouped_cohort_call(
     results are unsorted back so callers see cohort order. Each client's
     trajectory depends only on (globals, its rows, its key) — sorting and
     grouping change scheduling, not numerics (same equality class as the
-    fused-vs-vmapped comparison, tests/test_cohort_conv.py)."""
+    fused-vs-vmapped comparison, tests/test_cohort_conv.py). ``groups``
+    was resolved at build time against the SAME cohort size the fused
+    update was compiled for, so the helper's re-resolution is a no-op
+    here (a lane-count mismatch would fail loudly on the update's
+    static shapes regardless)."""
     if groups == 1:
         return cohort_update(variables, idx_rows, mask_rows, x, y, ckeys)
-    C = idx_rows.shape[0]
-    sub = C // groups
-    order = jnp.argsort(-jnp.sum(mask_rows, axis=1))
-    inv = jnp.argsort(order)
-    idx_s, mask_s, keys_s = idx_rows[order], mask_rows[order], ckeys[order]
-    outs = []
-    for g in range(groups):
-        sl = slice(g * sub, (g + 1) * sub)
-        outs.append(
-            cohort_update(
-                variables, idx_s[sl], mask_s[sl], x, y, keys_s[sl]
-            )
-        )
-    cat = lambda *leaves: jnp.concatenate(leaves, axis=0)
-    stacked_vars, n_k, msums = (
-        jax.tree.map(cat, *[o[i] for o in outs]) for i in range(3)
+    return _size_grouped_lanes(
+        lambda i, m, k: cohort_update(variables, i, m, x, y, k),
+        (idx_rows, mask_rows, ckeys), mask_rows, groups,
     )
-    unsort = lambda t: jax.tree.map(lambda v: v[inv], t)
-    return unsort(stacked_vars), n_k[inv], unsort(msums)
 
 
 class FedAvgSim:

@@ -285,12 +285,25 @@ def build_gan_local_update(
                         + jnp.where(valid, 1.0, 0.0),
                     },
                 )
-                return out, None
+                return out
 
-            carry, _ = jax.lax.scan(
-                step_body,
+            # dynamic trip count: the epoch perm sorts this client's
+            # REAL samples first, so steps beyond ceil(n_k/B) are
+            # provably pure-padding no-ops (the where-gating above) —
+            # skip them. Under vmap the bound is per-lane, and JAX's
+            # batched while runs each group to ITS max with finished
+            # lanes masked — which is what makes the size-sorted
+            # sub-cohort scheduling in gan_family effective (the same
+            # lever as the classification cohort path, TrainConfig
+            # .cohort_groups).
+            n_steps = jnp.minimum(
+                (jnp.sum(mask_row).astype(jnp.int32) + batch_size - 1)
+                // batch_size,
+                steps_per_epoch,
+            )
+            carry = jax.lax.fori_loop(
+                0, n_steps, lambda i, c: step_body(c, i),
                 (g_vars, d_vars, g_os, d_os, sums),
-                jnp.arange(steps_per_epoch),
             )
             return carry, None
 

@@ -52,6 +52,15 @@ from fedml_tpu.algorithms.stack_utils import (
     stack_scatter as _stack_scatter,
     vmap_init as _vmap_init,
 )
+# The GAN step loop's trip count is per-lane dynamic
+# (gan_core.build_gan_local_update), and vmap's batched while runs each
+# call to the max over ITS lanes — so size_grouped_lanes (shared with
+# the classification path, stack_utils) makes small clients stop at
+# their own group's maximum instead of the whole cohort's. The group
+# count is resolved inside the helper against the true lane count.
+from fedml_tpu.algorithms.stack_utils import (
+    size_grouped_lanes as _size_grouped_lanes,
+)
 
 
 class FedGANState(NamedTuple):
@@ -100,11 +109,16 @@ class FedGANSim:
             cfg.clients_per_round,
         )
         ckeys = jax.vmap(lambda c: R.client_key(rkey, c))(cohort)
-        g_stack, d_stack, n_k, sums = jax.vmap(
-            self.local_update, in_axes=(None, None, 0, 0, None, None, 0)
-        )(
-            state.gen_vars, state.disc_vars, arrays.idx[cohort],
-            arrays.mask[cohort], arrays.x, arrays.y, ckeys,
+        mask_rows = arrays.mask[cohort]
+        g_stack, d_stack, n_k, sums = _size_grouped_lanes(
+            lambda idxs, masks, keys: jax.vmap(
+                self.local_update, in_axes=(None, None, 0, 0, None, None, 0)
+            )(
+                state.gen_vars, state.disc_vars, idxs, masks,
+                arrays.x, arrays.y, keys,
+            ),
+            (arrays.idx[cohort], mask_rows, ckeys), mask_rows,
+            self.cfg.train.cohort_groups,
         )
         new_gen = T.tree_weighted_mean(g_stack, n_k)
         new_disc = T.tree_weighted_mean(d_stack, n_k)
@@ -248,12 +262,19 @@ class FedGDKDSim:
             jnp.any(is_new), do_correct, lambda v: v, cls_vars
         )
 
-        # 2. adversarial co-training (generator from global)
-        g_stack, cls_vars, n_k, sums = jax.vmap(
-            self.local_update, in_axes=(None, 0, 0, 0, None, None, 0)
-        )(
-            state.gen_vars, cls_vars, arrays.idx[cohort],
-            arrays.mask[cohort], arrays.x, arrays.y, ckeys,
+        # 2. adversarial co-training (generator from global), scheduled
+        #    in size-sorted sub-groups so small clients' step loops stop
+        #    at their own group's trip count
+        mask_rows = arrays.mask[cohort]
+        g_stack, cls_vars, n_k, sums = _size_grouped_lanes(
+            lambda cvars, idxs, masks, keys: jax.vmap(
+                self.local_update, in_axes=(None, 0, 0, 0, None, None, 0)
+            )(
+                state.gen_vars, cvars, idxs, masks,
+                arrays.x, arrays.y, keys,
+            ),
+            (cls_vars, arrays.idx[cohort], mask_rows, ckeys), mask_rows,
+            self.cfg.train.cohort_groups,
         )
 
         # 3. generator-only FedAvg (server.py:105-108)
