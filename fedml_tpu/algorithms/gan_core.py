@@ -166,6 +166,22 @@ def discriminator_loss_acgan(
 # ---------------------------------------------------------------------------
 
 
+def dynamic_trip_count(mask_row, batch_size: int, max_steps: int):
+    """Per-lane dynamic step-loop bound: ceil(n_k / B) clamped to the
+    static maximum. VALID ONLY when the epoch perm sorts this client's
+    real samples first (every GAN-family loop using this does:
+    ``argsort(1.0 - mask_row[perm], stable=True)``) — then the skipped
+    tail steps are exactly the fully-padded no-op batches. Under vmap
+    the bound is per-lane and the batched while runs each call to the
+    max over its lanes, which is what ``stack_utils.size_grouped_lanes``
+    exploits."""
+    return jnp.minimum(
+        (jnp.sum(mask_row).astype(jnp.int32) + batch_size - 1)
+        // batch_size,
+        max_steps,
+    )
+
+
 def build_gan_local_update(
     gen: GanModel,
     disc: DiscHandle,
@@ -287,19 +303,8 @@ def build_gan_local_update(
                 )
                 return out
 
-            # dynamic trip count: the epoch perm sorts this client's
-            # REAL samples first, so steps beyond ceil(n_k/B) are
-            # provably pure-padding no-ops (the where-gating above) —
-            # skip them. Under vmap the bound is per-lane, and JAX's
-            # batched while runs each group to ITS max with finished
-            # lanes masked — which is what makes the size-sorted
-            # sub-cohort scheduling in gan_family effective (the same
-            # lever as the classification cohort path, TrainConfig
-            # .cohort_groups).
-            n_steps = jnp.minimum(
-                (jnp.sum(mask_row).astype(jnp.int32) + batch_size - 1)
-                // batch_size,
-                steps_per_epoch,
+            n_steps = dynamic_trip_count(
+                mask_row, batch_size, steps_per_epoch
             )
             carry = jax.lax.fori_loop(
                 0, n_steps, lambda i, c: step_body(c, i),

@@ -511,11 +511,14 @@ class FedDTGSim:
                         sel(new_g, g_vars), sel(new_d, d_vars),
                         sel(new_c, c_vars), sel(new_g_os, g_os),
                         sel(new_d_os, d_os), sel(new_c_os, c_os),
-                    ), None
+                    )
 
-                carry, _ = jax.lax.scan(
-                    step_body, (g_vars, d_vars, c_vars, g_os, d_os, c_os),
-                    jnp.arange(steps_per_epoch),
+                n_steps = G.dynamic_trip_count(
+                    mask_row, batch_size, steps_per_epoch
+                )
+                carry = jax.lax.fori_loop(
+                    0, n_steps, lambda i, c: step_body(c, i),
+                    (g_vars, d_vars, c_vars, g_os, d_os, c_os),
                 )
                 return carry, None
 
@@ -556,11 +559,17 @@ class FedDTGSim:
         ckeys = jax.vmap(lambda c: R.client_key(rkey, c))(cohort)
         cls_vars = _stack_gather(state.cls_stack, cohort)
 
-        g_stack, d_stack, cls_vars, n_k = jax.vmap(
-            self.local_update, in_axes=(None, None, 0, 0, 0, None, None, 0)
-        )(
-            state.gen_vars, state.disc_vars, cls_vars, arrays.idx[cohort],
-            arrays.mask[cohort], arrays.x, arrays.y, ckeys,
+        mask_rows = arrays.mask[cohort]
+        g_stack, d_stack, cls_vars, n_k = _size_grouped_lanes(
+            lambda cvars, idxs, masks, keys: jax.vmap(
+                self.local_update,
+                in_axes=(None, None, 0, 0, 0, None, None, 0),
+            )(
+                state.gen_vars, state.disc_vars, cvars, idxs, masks,
+                arrays.x, arrays.y, keys,
+            ),
+            (cls_vars, arrays.idx[cohort], mask_rows, ckeys), mask_rows,
+            self.cfg.train.cohort_groups,
         )
         new_gen = T.tree_weighted_mean(g_stack, n_k)
         new_disc = T.tree_weighted_mean(d_stack, n_k)

@@ -33,7 +33,10 @@ from fedml_tpu.algorithms.base import make_client_optimizer
 from fedml_tpu.config import ExperimentConfig
 from fedml_tpu.core import random as R
 from fedml_tpu.core import tree as T
-from fedml_tpu.algorithms.stack_utils import vmap_init
+from fedml_tpu.algorithms.stack_utils import (
+    size_grouped_lanes as _size_grouped_lanes,
+    vmap_init,
+)
 from fedml_tpu.data.federated import FederatedArrays, FederatedData, arrays_and_batch
 from fedml_tpu.models.gan import GanModel
 
@@ -159,10 +162,12 @@ class FedSSGANSim:
                     return (
                         sel(new_g, g_vars), sel(new_d, d_vars),
                         sel(new_g_os, g_os), sel(new_d_os, d_os),
-                    ), None
+                    )
 
-                carry2, _ = jax.lax.scan(
-                    step, (g_vars, d_vars, g_os, d_os), jnp.arange(steps)
+                n_steps = G.dynamic_trip_count(mask_row, batch_size, steps)
+                carry2 = jax.lax.fori_loop(
+                    0, n_steps, lambda i, c: step(c, i),
+                    (g_vars, d_vars, g_os, d_os),
                 )
                 return carry2, None
 
@@ -195,11 +200,16 @@ class FedSSGANSim:
             cfg.clients_per_round,
         )
         ckeys = jax.vmap(lambda c: R.client_key(rkey, c))(cohort)
-        g_stack, d_stack, n_k = jax.vmap(
-            self.local_update, in_axes=(None, None, 0, 0, None, None, 0)
-        )(
-            state.gen_vars, state.disc_vars, arrays.idx[cohort],
-            arrays.mask[cohort], arrays.x, arrays.y, ckeys,
+        mask_rows = arrays.mask[cohort]
+        g_stack, d_stack, n_k = _size_grouped_lanes(
+            lambda idxs, masks, keys: jax.vmap(
+                self.local_update, in_axes=(None, None, 0, 0, None, None, 0)
+            )(
+                state.gen_vars, state.disc_vars, idxs, masks,
+                arrays.x, arrays.y, keys,
+            ),
+            (arrays.idx[cohort], mask_rows, ckeys), mask_rows,
+            self.cfg.train.cohort_groups,
         )
         # whole-model FedAvg (fedssgan_api.py:96-100)
         return (
