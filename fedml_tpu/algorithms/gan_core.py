@@ -466,3 +466,110 @@ def build_kd_update(
         return variables, losses
 
     return kd
+
+
+def build_cohort_kd_update(
+    model,  # FedModel with supports_cohort() — the classifier itself
+    train_cfg: TrainConfig,
+    gan_cfg: GanConfig,
+    size: int,
+    batch_size: int,
+    cohort: int,
+):
+    """Cohort-fused :func:`build_kd_update`: every client's KD pass runs
+    inside ONE cohort-grouped network application per batch instead of
+    ``vmap`` over per-client classifiers (whose batched-kernel convs
+    lower poorly on TPU — the same motivation as
+    ``base.build_cohort_local_update``). All clients distill on the SAME
+    synthetic batches, only the leave-one-out teacher differs per
+    client, so the input is a broadcast and per-client losses sum so
+    that ``d(total)/d(params_c)`` is exactly client c's gradient.
+
+    Same contract as ``vmap(build_kd_update(...), in_axes=(0, None,
+    None, 0, 0))``: ``kd(stacked_vars, synth_x, labels, teachers [C,S,K],
+    rngs [C])`` -> (stacked vars, loss sums with [C] leaves). Eligible
+    only for dropout-free classifiers with per-client-stackable
+    optimizer state (``base.cohort_update_supported``) — dropout would
+    draw one mask over the widened activations, and the per-client rng
+    streams (dropout-only) would differ from the vmapped path."""
+    assert size % batch_size == 0
+    n_batches = size // batch_size
+    C = cohort
+    opt = make_client_optimizer(train_cfg)
+
+    def loss_fn(stacked_params, static_stacked, xb, yb, tb, rng):
+        variables = {**static_stacked, "params": stacked_params}
+        x_cb = jnp.broadcast_to(xb[None], (C,) + xb.shape)
+        logits, new_vars = model.apply_cohort_train(variables, x_cb, rng)
+        kd_l = jax.vmap(
+            lambda s, t: KD.soft_target(s, t, gan_cfg.kd_temperature)
+        )(logits, tb)  # [C]
+        ce = jax.vmap(
+            lambda lg: jnp.mean(
+                optax.softmax_cross_entropy_with_integer_labels(lg, yb)
+            )
+        )(logits)
+        per_client = (1 - gan_cfg.kd_alpha) * ce + gan_cfg.kd_alpha * kd_l
+        return jnp.sum(per_client), (new_vars, per_client, kd_l)
+
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+    def kd(stacked_vars, synth_x, labels, teachers, rngs):
+        opt_state = opt.init(stacked_vars["params"])
+        # rng feeds dropout only, which cohort support excludes; one
+        # representative key keeps the signature uniform
+        rng0 = rngs[0]
+
+        def epoch_body(carry, ekey):
+            variables, opt_state, losses = carry
+
+            def step_body(carry2, i):
+                variables, opt_state, losses = carry2
+                sl = lambda a: jax.lax.dynamic_slice_in_dim(
+                    a, i * batch_size, batch_size
+                )
+                tb = jax.vmap(
+                    lambda t: jax.lax.dynamic_slice_in_dim(
+                        t, i * batch_size, batch_size
+                    )
+                )(teachers)
+                params = variables["params"]
+                static = {
+                    k: v for k, v in variables.items() if k != "params"
+                }
+                (_, (new_vars, dist_l, kd_l)), grads = grad_fn(
+                    params, static, sl(synth_x), sl(labels), tb,
+                    jax.random.fold_in(ekey, i),
+                )
+                updates, new_os = opt.update(grads, opt_state, params)
+                new_vars = {
+                    **new_vars,
+                    "params": optax.apply_updates(params, updates),
+                }
+                losses = {
+                    "kd_loss_sum": losses["kd_loss_sum"] + kd_l,
+                    "dist_loss_sum": losses["dist_loss_sum"] + dist_l,
+                    "batches": losses["batches"] + 1.0,
+                }
+                return (new_vars, new_os, losses), None
+
+            carry, _ = jax.lax.scan(
+                step_body, (variables, opt_state, losses),
+                jnp.arange(n_batches),
+            )
+            return carry, None
+
+        losses0 = {
+            "kd_loss_sum": jnp.zeros((C,)),
+            "dist_loss_sum": jnp.zeros((C,)),
+            "batches": jnp.zeros((C,)),
+        }
+        ekeys = jax.vmap(lambda e: jax.random.fold_in(rng0, e))(
+            jnp.arange(gan_cfg.kd_epochs)
+        )
+        (variables, _, losses), _ = jax.lax.scan(
+            epoch_body, (stacked_vars, opt_state, losses0), ekeys
+        )
+        return variables, losses
+
+    return kd

@@ -202,10 +202,44 @@ class FedGDKDSim:
         self.kd_update = G.build_kd_update(
             self.disc, cfg.train, gan, self.synth_size, self.batch_size
         )
+        # cohort-fused KD: one grouped network application per synth
+        # batch instead of vmapped per-client classifiers (same
+        # numerics, far better conv lowering). Used for both KD sites
+        # when the classifier/optimizer are eligible.
+        from fedml_tpu.algorithms.base import cohort_update_supported
+
+        # the true lane count: sample_clients caps the cohort at the
+        # DATA's client count (natural splits can disagree with config)
+        kd_cohort = min(
+            cfg.fed.clients_per_round, self.arrays.num_clients
+        )
+        self.cohort_kd = (
+            G.build_cohort_kd_update(
+                classifier, cfg.train, gan, self.synth_size,
+                self.batch_size, kd_cohort,
+            )
+            if cfg.train.cohort_fused
+            and cohort_update_supported(classifier, cfg.train)
+            else None
+        )
         self.task = make_task(data.task)
         self.evaluator = build_evaluator(classifier, self.task)
         self.root_key = jax.random.key(cfg.seed)
         self._round_fn = jax.jit(self._round, donate_argnums=(0,))
+
+    def _run_kd(self, cls_vars, synth_x, synth_y, teachers, keys):
+        """Single dispatch point for both KD sites (drift correction +
+        leave-one-out distillation): the cohort-fused update when
+        eligible, else vmapped per-client kd. ``teachers`` is always
+        [C, S, K] (broadcast the shared mean teacher for drift
+        correction)."""
+        if self.cohort_kd is not None:
+            return self.cohort_kd(
+                cls_vars, synth_x, synth_y, teachers, keys
+            )
+        return jax.vmap(
+            self.kd_update, in_axes=(0, None, None, 0, 0)
+        )(cls_vars, synth_x, synth_y, teachers, keys)
 
     def init(self) -> FedGDKDState:
         k = jax.random.fold_in(self.root_key, 0x7FFFFFFF)
@@ -242,12 +276,16 @@ class FedGDKDSim:
         )
 
         def do_correct(cls_vars):
-            corrected, _ = jax.vmap(
-                self.kd_update, in_axes=(0, None, None, None, 0)
-            )(
+            dkeys = jax.vmap(
+                lambda k: jax.random.fold_in(k, 0xD1F7)
+            )(ckeys)
+            corrected, _ = self._run_kd(
                 cls_vars, state.prev_synth_x, state.prev_synth_y,
-                state.prev_teacher,
-                jax.vmap(lambda k: jax.random.fold_in(k, 0xD1F7))(ckeys),
+                jnp.broadcast_to(
+                    state.prev_teacher[None],
+                    (dkeys.shape[0],) + state.prev_teacher.shape,
+                ),
+                dkeys,
             )
             return jax.tree.map(
                 lambda new, old: jnp.where(
@@ -293,11 +331,9 @@ class FedGDKDSim:
         loo_teacher = (jnp.sum(logits, 0)[None] - logits) / jnp.maximum(
             c - 1, 1
         )
-        cls_vars, kd_losses = jax.vmap(
-            self.kd_update, in_axes=(0, None, None, 0, 0)
-        )(
-            cls_vars, synth_x, synth_y, loo_teacher,
-            jax.vmap(lambda k: jax.random.fold_in(k, 0xAD))(ckeys),
+        kd_keys = jax.vmap(lambda k: jax.random.fold_in(k, 0xAD))(ckeys)
+        cls_vars, kd_losses = self._run_kd(
+            cls_vars, synth_x, synth_y, loo_teacher, kd_keys
         )
 
         new_stack = _stack_scatter(state.cls_stack, cohort, cls_vars)

@@ -218,3 +218,72 @@ def test_gan_cohort_groups_are_scheduling_only():
                       jax.tree.leaves((gb.gen_vars, gb.disc_vars))):
         np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
                                    rtol=1e-5, atol=1e-6)
+
+
+def test_cohort_kd_matches_vmapped_kd():
+    """The cohort-fused KD update (one grouped network application per
+    synth batch) reproduces vmap(build_kd_update) — same per-client
+    grads/updates, f32 grouped-vs-vmapped round-off only (the
+    equality class of tests/test_cohort_conv.py)."""
+    import dataclasses
+
+    base = tiny_cfg()
+    cfg = dataclasses.replace(
+        base,
+        model=dataclasses.replace(base.model, name="cnn_small"),
+        gan=dataclasses.replace(base.gan, kd_epochs=2),
+    )
+    data = tiny_data(cfg)
+    gen = create_conditional_generator(10, 28, 1, nz=16, ngf=8)
+    sim = FedGDKDSim(gen, create_model(cfg.model), data, cfg)
+    assert sim.cohort_kd is not None  # cnn_small: no dropout, sgd
+    state = sim.init()
+    cls_vars = jax.tree.map(lambda s: s[:2], state.cls_stack)
+    synth_x = jnp.linspace(0, 1, sim.synth_size * 28 * 28).reshape(
+        (sim.synth_size, 28, 28, 1)
+    ).astype(jnp.float32)
+    synth_y = (jnp.arange(sim.synth_size) % 10).astype(jnp.int32)
+    teachers = jax.random.normal(
+        jax.random.key(3), (2, sim.synth_size, 10)
+    )
+    keys = jax.vmap(
+        lambda i: jax.random.fold_in(jax.random.key(7), i)
+    )(jnp.arange(2))
+    v_vars, v_loss = jax.vmap(
+        sim.kd_update, in_axes=(0, None, None, 0, 0)
+    )(cls_vars, synth_x, synth_y, teachers, keys)
+    c_vars, c_loss = sim.cohort_kd(
+        cls_vars, synth_x, synth_y, teachers, keys
+    )
+    for a, b in zip(jax.tree.leaves(v_vars), jax.tree.leaves(c_vars)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5
+        )
+    np.testing.assert_allclose(
+        np.asarray(v_loss["kd_loss_sum"]),
+        np.asarray(c_loss["kd_loss_sum"]), rtol=2e-4, atol=2e-4,
+    )
+
+
+def test_fedgdkd_cohort_kd_rounds_run():
+    """FedGDKD with a cohort-KD-ELIGIBLE classifier (cnn_small: no
+    dropout, sgd) executes both cohort-KD sites end-to-end: round 1
+    (LOO distillation) and round 2 (drift correction for new joiners,
+    broadcast mean teacher)."""
+    import dataclasses
+
+    base = tiny_cfg()
+    cfg = dataclasses.replace(
+        base, model=dataclasses.replace(base.model, name="cnn_small")
+    )
+    data = tiny_data(cfg)
+    gen = create_conditional_generator(10, 28, 1, nz=16, ngf=8)
+    sim = FedGDKDSim(gen, create_model(cfg.model), data, cfg)
+    assert sim.cohort_kd is not None
+    state = sim.init()
+    state, m = sim.run_round(state)
+    assert np.isfinite(float(m["kd_loss"]))
+    state, m = sim.run_round(state)  # drift-correction path
+    assert np.isfinite(float(m["kd_loss"]))
+    ev = sim.evaluate_clients(state)
+    assert 0.0 <= ev["test_acc"] <= 1.0
