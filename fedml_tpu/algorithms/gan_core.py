@@ -93,6 +93,50 @@ def make_gen_optimizer(cfg: GanConfig) -> optax.GradientTransformation:
     raise ValueError(f"unknown gen optimizer: {cfg.gen_optimizer}")
 
 
+def make_stacked_gen_optimizer(cfg: GanConfig) -> optax.GradientTransformation:
+    """Per-client generator optimizer over STACKED [C, ...] params — the
+    cohort-fused GAN update's replacement for ``vmap`` of
+    :func:`make_gen_optimizer`. Plain sgd is stateless-per-leaf and
+    stacks trivially; adam needs a per-client step COUNT ([C] instead of
+    optax's scalar) so a padded step gated out for one client does not
+    advance its bias correction. The update mirrors
+    ``optax.scale_by_adam``'s expressions term for term (same moment
+    recurrences, ``1 - b**count`` bias correction, eps placement), so a
+    lane of this transformation is bitwise the per-client
+    ``optax.adam``."""
+    if cfg.gen_optimizer == "sgd":
+        return optax.sgd(cfg.gen_lr)
+    if cfg.gen_optimizer != "adam":
+        raise ValueError(f"unknown gen optimizer: {cfg.gen_optimizer}")
+    lr, b1, b2, eps = cfg.gen_lr, 0.9, 0.999, 1e-8
+
+    def init(params):
+        c = jax.tree.leaves(params)[0].shape[0]
+        return (
+            jnp.zeros((c,), jnp.int32),
+            jax.tree.map(jnp.zeros_like, params),
+            jax.tree.map(jnp.zeros_like, params),
+        )
+
+    def update(grads, state, params=None):
+        count, mu, nu = state
+        count_inc = count + 1
+        mu = jax.tree.map(lambda g, t: (1 - b1) * g + b1 * t, grads, mu)
+        nu = jax.tree.map(
+            lambda g, t: (1 - b2) * (g * g) + b2 * t, grads, nu
+        )
+
+        def upd(m, v):
+            shape = (count_inc.shape[0],) + (1,) * (m.ndim - 1)
+            mh = m / (1 - b1 ** count_inc).reshape(shape)
+            vh = v / (1 - b2 ** count_inc).reshape(shape)
+            return -lr * (mh / (jnp.sqrt(vh) + eps))
+
+        return jax.tree.map(upd, mu, nu), (count_inc, mu, nu)
+
+    return optax.GradientTransformation(init, update)
+
+
 def _masked_mean(v, w):
     return jnp.sum(v * w) / jnp.maximum(jnp.sum(w), 1.0)
 
@@ -466,6 +510,194 @@ def build_kd_update(
         return variables, losses
 
     return kd
+
+
+def build_cohort_gan_update(
+    gen: GanModel,
+    classifier,  # FedModel with supports_cohort() — the ssgan "D"
+    train_cfg: TrainConfig,
+    gan_cfg: GanConfig,
+    batch_size: int,
+    max_n: int,
+    cohort: int,
+):
+    """Cohort-fused :func:`build_gan_local_update` (ssgan mode): the
+    whole sub-cohort's adversarial phase runs as grouped networks — the
+    generator pyramid via :meth:`GanModel.apply_cohort_train`, the
+    classifier via :meth:`FedModel.apply_cohort_train` — instead of
+    ``vmap`` over per-client nets (batched-kernel convs + per-op layout
+    transposes, the lowering the cohort machinery exists to avoid).
+
+    Same contract as ``vmap(build_gan_local_update(...), in_axes=(None,
+    0, 0, 0, None, None, 0))``: ``update(gen_vars_global, cls_stacked,
+    idx_rows [C, max_n], mask_rows, x, y, rngs [C])`` returns
+    ``(g_stacked, cls_stacked, n_k [C], loss sums with [C] leaves)``,
+    with the SAME per-step RNG derivation per client (z / fake-label
+    draws are bitwise the vmapped path's). Per-client losses are summed
+    so ``d(total)/d(params_c)`` is exactly client c's gradient; a
+    fully-padded batch is where-gated per client (params, optimizer
+    state — including the per-client adam step count of
+    :func:`make_stacked_gen_optimizer` — and generator BN stats), so
+    padded steps remain strict no-ops. The step loop's trip count is
+    the SUB-COHORT's max ceil(n_k/B) (dynamic), which is what makes
+    ``stack_utils.size_grouped_lanes`` effective on top."""
+    assert max_n % batch_size == 0
+    steps_per_epoch = max_n // batch_size
+    C = cohort
+    g_opt = make_stacked_gen_optimizer(gan_cfg)
+    d_opt = make_client_optimizer(train_cfg)
+
+    def g_loss_fn(g_params, g_static, d_vars, z, gen_labels, w_rows):
+        g_vars = {**g_static, "params": g_params}
+        fakes, new_g_vars = gen.apply_cohort_train(g_vars, z, gen_labels)
+        out, _ = classifier.apply_cohort_train(
+            d_vars, fakes, jax.random.key(0)
+        )
+        per = jax.vmap(generator_loss_ssgan)(out, gen_labels, w_rows)
+        return jnp.sum(per), (new_g_vars, fakes, per)
+
+    def d_loss_fn(d_params, d_static, fakes, gen_labels, x_cb, y_cb,
+                  w_rows):
+        d_vars = {**d_static, "params": d_params}
+        cls_fake, d1 = classifier.apply_cohort_train(
+            d_vars, fakes, jax.random.key(0)
+        )
+        cls_real, d2 = classifier.apply_cohort_train(
+            d1, x_cb, jax.random.key(0)
+        )
+        per = jax.vmap(discriminator_loss_ssgan)(
+            cls_fake, gen_labels, cls_real, y_cb, w_rows
+        )
+        return jnp.sum(per), (d2, per)
+
+    g_grad = jax.value_and_grad(g_loss_fn, has_aux=True)
+    d_grad = jax.value_and_grad(d_loss_fn, has_aux=True)
+
+    def update(gen_vars, cls_vars, idx_rows, mask_rows, x, y, rngs):
+        g_vars0 = jax.tree.map(
+            lambda v: jnp.broadcast_to(v[None], (C,) + v.shape), gen_vars
+        )
+
+        def epoch_body(carry, ekeys):
+            g_vars, d_vars, g_os, d_os, sums = carry
+            # per-client valid-first perms, bitwise the vmapped path's
+            def mk_perm(ek, mrow):
+                p = jax.random.permutation(ek, max_n)
+                return p[jnp.argsort(1.0 - mrow[p], stable=True)]
+
+            perms = jax.vmap(mk_perm)(ekeys, mask_rows)
+
+            def step_body(step, carry2):
+                g_vars, d_vars, g_os, d_os, sums = carry2
+                take = jax.lax.dynamic_slice_in_dim(
+                    perms, step * batch_size, batch_size, axis=1
+                )  # [C, B]
+                b_idx = jnp.take_along_axis(idx_rows, take, axis=1)
+                w_b = jnp.take_along_axis(mask_rows, take, axis=1)
+                x_cb = jnp.take(x, b_idx.reshape(-1), axis=0).reshape(
+                    (C, batch_size) + x.shape[1:]
+                )
+                y_cb = jnp.take(y, b_idx.reshape(-1), axis=0).reshape(
+                    (C, batch_size)
+                )
+                skeys = jax.vmap(
+                    lambda ek: jax.random.fold_in(ek, step)
+                )(ekeys)
+                ks = jax.vmap(lambda k: jax.random.split(k, 4))(skeys)
+                z = jax.vmap(
+                    lambda k: gen.sample_noise(k, batch_size)
+                )(ks[:, 0])
+                gen_labels = jax.vmap(
+                    lambda k: gen.sample_labels(k, batch_size)
+                )(ks[:, 1])
+
+                g_params = g_vars["params"]
+                g_static = {
+                    k: v for k, v in g_vars.items() if k != "params"
+                }
+                (_, (new_g_vars, fakes, g_per)), g_grads = g_grad(
+                    g_params, g_static, d_vars, z, gen_labels, w_b
+                )
+                g_updates, new_g_os = g_opt.update(
+                    g_grads, g_os, g_params
+                )
+                new_g_vars = {
+                    **new_g_vars,
+                    "params": optax.apply_updates(g_params, g_updates),
+                }
+
+                d_params = d_vars["params"]
+                d_static = {
+                    k: v for k, v in d_vars.items() if k != "params"
+                }
+                (_, (new_d_vars, d_per)), d_grads = d_grad(
+                    d_params, d_static, jax.lax.stop_gradient(fakes),
+                    gen_labels, x_cb, y_cb, w_b,
+                )
+                d_updates, new_d_os = d_opt.update(
+                    d_grads, d_os, d_params
+                )
+                new_d_vars = {
+                    **new_d_vars,
+                    "params": optax.apply_updates(d_params, d_updates),
+                }
+
+                valid = jnp.sum(w_b, axis=1) > 0  # [C]
+
+                def sel(new, old):
+                    return jax.tree.map(
+                        lambda a, b: jnp.where(
+                            valid.reshape((C,) + (1,) * (a.ndim - 1)),
+                            a, b,
+                        ),
+                        new, old,
+                    )
+
+                sums = {
+                    "g_loss_sum": sums["g_loss_sum"]
+                    + jnp.where(valid, g_per, 0.0),
+                    "d_loss_sum": sums["d_loss_sum"]
+                    + jnp.where(valid, d_per, 0.0),
+                    "batches": sums["batches"]
+                    + jnp.where(valid, 1.0, 0.0),
+                }
+                return (
+                    sel(new_g_vars, g_vars), sel(new_d_vars, d_vars),
+                    sel(new_g_os, g_os), sel(new_d_os, d_os), sums,
+                )
+
+            n_steps = jnp.max(
+                jax.vmap(
+                    lambda m: dynamic_trip_count(
+                        m, batch_size, steps_per_epoch
+                    )
+                )(mask_rows)
+            )
+            carry = jax.lax.fori_loop(
+                0, n_steps, step_body,
+                (g_vars, d_vars, g_os, d_os, sums),
+            )
+            return carry, None
+
+        sums0 = {
+            "g_loss_sum": jnp.zeros((C,)),
+            "d_loss_sum": jnp.zeros((C,)),
+            "batches": jnp.zeros((C,)),
+        }
+        g_os = g_opt.init(g_vars0["params"])
+        d_os = d_opt.init(cls_vars["params"])
+        ekeys = jax.vmap(
+            lambda e: jax.vmap(
+                lambda r: jax.random.fold_in(r, e)
+            )(rngs)
+        )(jnp.arange(train_cfg.epochs))  # [E, C]
+        (g_vars, d_vars, _, _, sums), _ = jax.lax.scan(
+            epoch_body, (g_vars0, cls_vars, g_os, d_os, sums0), ekeys
+        )
+        n_k = jnp.sum(mask_rows, axis=1)
+        return g_vars, d_vars, n_k, sums
+
+    return update
 
 
 def build_cohort_kd_update(

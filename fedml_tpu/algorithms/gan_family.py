@@ -213,13 +213,31 @@ class FedGDKDSim:
         kd_cohort = min(
             cfg.fed.clients_per_round, self.arrays.num_clients
         )
+        eligible = cfg.train.cohort_fused and cohort_update_supported(
+            classifier, cfg.train
+        )
         self.cohort_kd = (
             G.build_cohort_kd_update(
                 classifier, cfg.train, gan, self.synth_size,
                 self.batch_size, kd_cohort,
             )
-            if cfg.train.cohort_fused
-            and cohort_update_supported(classifier, cfg.train)
+            if eligible
+            else None
+        )
+        # cohort-fused adversarial phase: grouped generator pyramid +
+        # grouped classifier per sub-group (built at the sub-group lane
+        # count the size-sorted scheduler will slice)
+        from fedml_tpu.algorithms.stack_utils import resolve_cohort_groups
+
+        self._gan_groups = resolve_cohort_groups(
+            cfg.train.cohort_groups, kd_cohort, auto_group_size=2
+        )
+        self.cohort_gan = (
+            G.build_cohort_gan_update(
+                gen, classifier, cfg.train, gan, self.batch_size, max_n,
+                kd_cohort // self._gan_groups,
+            )
+            if eligible and gen.supports_cohort()
             else None
         )
         self.task = make_task(data.task)
@@ -302,17 +320,26 @@ class FedGDKDSim:
 
         # 2. adversarial co-training (generator from global), scheduled
         #    in size-sorted sub-groups so small clients' step loops stop
-        #    at their own group's trip count
+        #    at their own group's trip count. The fused path runs each
+        #    sub-group as ONE grouped generator + classifier network.
         mask_rows = arrays.mask[cohort]
-        g_stack, cls_vars, n_k, sums = _size_grouped_lanes(
-            lambda cvars, idxs, masks, keys: jax.vmap(
+        if self.cohort_gan is not None:
+            inner = lambda cvars, idxs, masks, keys: self.cohort_gan(
+                state.gen_vars, cvars, idxs, masks,
+                arrays.x, arrays.y, keys,
+            )
+            requested = self._gan_groups
+        else:
+            inner = lambda cvars, idxs, masks, keys: jax.vmap(
                 self.local_update, in_axes=(None, 0, 0, 0, None, None, 0)
             )(
                 state.gen_vars, cvars, idxs, masks,
                 arrays.x, arrays.y, keys,
-            ),
-            (cls_vars, arrays.idx[cohort], mask_rows, ckeys), mask_rows,
-            self.cfg.train.cohort_groups,
+            )
+            requested = self.cfg.train.cohort_groups
+        g_stack, cls_vars, n_k, sums = _size_grouped_lanes(
+            inner, (cls_vars, arrays.idx[cohort], mask_rows, ckeys),
+            mask_rows, requested,
         )
 
         # 3. generator-only FedAvg (server.py:105-108)

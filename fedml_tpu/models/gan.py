@@ -48,32 +48,55 @@ class _GeneratorPyramid(nn.Module):
     """Shared DCGAN upsampling trunk: Dense projection -> reshape ->
     (ConvTranspose + BN + relu) x n_blocks -> ConvTranspose -> tanh
     (the common body of reference ``ImageGenerator`` and
-    ``ConditionalImageGenerator``, ``generator.py:29-125``)."""
+    ``ConditionalImageGenerator``, ``generator.py:29-125``).
+
+    ``cohort=C`` builds the cohort-grouped form (one widened network
+    runs C clients' generators at once — the GAN analog of
+    :mod:`fedml_tpu.models.cohort`): the projection becomes a stacked
+    CohortDense, transposed convs widen xC with ``feature_group_count=C``
+    (channel group c IS client c), BN per-channel stats stay per-client.
+    Input is ``[B, C, nz]``; output is GROUPED ``[B, H, W, C*channels]``
+    (callers ungroup). Scope names match the cohort=1 form, so stacked
+    per-client trees map onto it via ``models.cohort.stack_to_fat``."""
 
     img_size: int
     channels: int
     ngf: int
+    cohort: int = 1
 
     @nn.compact
     def __call__(self, gen_input, train: bool = False):
+        from fedml_tpu.models.cohort import dense as cohort_dense
+
+        C = self.cohort
         n_ups, init_size = _plan_upsampling(self.img_size)
         # final ConvTranspose is one of the upsamplings; inner blocks = rest
         n_blocks = n_ups - 1
         first_filters = self.ngf * (2 ** n_blocks)
-        h = nn.Dense(first_filters * init_size * init_size, name="l1")(
-            gen_input
-        )
-        h = h.reshape((-1, init_size, init_size, first_filters))
+        h = cohort_dense(
+            first_filters * init_size * init_size, C, name="l1"
+        )(gen_input)
+        if C == 1:
+            h = h.reshape((-1, init_size, init_size, first_filters))
+        else:
+            # [B, C, is*is*ff] -> grouped [B, is, is, C*ff] (c-major
+            # channel blocks; inverse of models.cohort.cohort_flatten)
+            b = h.shape[0]
+            h = h.reshape(b, C, init_size, init_size, first_filters)
+            h = h.transpose(0, 2, 3, 1, 4).reshape(
+                b, init_size, init_size, C * first_filters
+            )
         for i in range(n_blocks):
             feats = self.ngf * (2 ** (n_blocks - 1 - i))
             h = ConvTranspose2D(
-                feats, (4, 4), strides=(2, 2), padding="SAME", use_bias=False
+                feats * C, (4, 4), strides=(2, 2), padding="SAME",
+                use_bias=False, feature_group_count=C,
             )(h)
             h = nn.BatchNorm(use_running_average=not train)(h)
             h = nn.relu(h)
         h = ConvTranspose2D(
-            self.channels, (4, 4), strides=(2, 2), padding="SAME",
-            use_bias=False,
+            self.channels * C, (4, 4), strides=(2, 2), padding="SAME",
+            use_bias=False, feature_group_count=C,
         )(h)
         return jnp.tanh(h)
 
@@ -179,6 +202,68 @@ class GanModel:
     def apply_eval(self, variables, z, labels=None):
         args = (z, labels) if self.conditional else (z,)
         return self.module.apply(variables, *args, train=False)
+
+    def supports_cohort(self) -> bool:
+        """Cohort-grouped apply needs the pyramid-shaped generator (the
+        zoo's Image/ConditionalImageGenerator)."""
+        return isinstance(
+            self.module, (ImageGenerator, ConditionalImageGenerator)
+        )
+
+    def apply_cohort_train(self, stacked_vars, z, labels=None):
+        """Train-mode forward of C clients' generators at once in
+        cohort-grouped form (the GAN analog of
+        ``FedModel.apply_cohort_train``): label embeddings are looked up
+        per client in stacked form (elementwise, cheap), then the
+        pyramid runs as ONE widened grouped network. ``stacked_vars``
+        has leading client axis C on every leaf; ``z`` is [C, B, nz],
+        ``labels`` [C, B]. Returns (fakes [C, B, H, W, ch], updated
+        stacked vars). Numerically the per-client network re-laid-out —
+        same equality class as the classifier cohort path."""
+        from fedml_tpu.models.cohort import fat_to_stack, stack_to_fat
+
+        C = z.shape[0]
+        if C == 1:
+            squeezed = jax.tree.map(lambda v: v[0], stacked_vars)
+            fakes, new_vars = self.apply_train(
+                squeezed, z[0], labels[0] if labels is not None else None
+            )
+            return fakes[None], jax.tree.map(lambda v: v[None], new_vars)
+        p = stacked_vars["params"]
+        if self.conditional:
+            emb = jax.vmap(lambda table, lbl: table[lbl])(
+                p["label_emb"]["embedding"], labels
+            )  # [C, B, nz]
+            gen_input = z * emb
+        else:
+            gen_input = z
+        fat = {"params": stack_to_fat(p["pyramid"], C)}
+        if "batch_stats" in stacked_vars:
+            fat["batch_stats"] = stack_to_fat(
+                stacked_vars["batch_stats"]["pyramid"], C
+            )
+        pyramid = _GeneratorPyramid(
+            self.module.img_size, self.module.channels, self.module.ngf,
+            cohort=C,
+        )
+        out, mutated = pyramid.apply(
+            fat, gen_input.transpose(1, 0, 2), train=True,
+            mutable=["batch_stats"],
+        )
+        b, hh, ww, cch = out.shape
+        fakes = out.reshape(b, hh, ww, C, cch // C).transpose(
+            3, 0, 1, 2, 4
+        )
+        new_vars = stacked_vars
+        if "batch_stats" in stacked_vars:
+            new_vars = {
+                **stacked_vars,
+                "batch_stats": {
+                    **stacked_vars["batch_stats"],
+                    "pyramid": fat_to_stack(mutated["batch_stats"], C),
+                },
+            }
+        return fakes, new_vars
 
     def sample_noise(self, rng: jax.Array, n: int) -> jax.Array:
         """Gaussian latent (reference ``generate_noise_vector``,

@@ -459,6 +459,9 @@ class ConvTranspose2D(nn.Module):
     strides: Sequence[int] = (1, 1)
     padding: str = "SAME"
     use_bias: bool = True
+    # cohort-grouped form (models.cohort / the gan cohort pyramid):
+    # channel group c is client c, kernel cin is per-group
+    feature_group_count: int = 1
     kernel_init: Any = nn.initializers.lecun_normal()
     bias_init: Any = nn.initializers.zeros_init()
 
@@ -467,7 +470,9 @@ class ConvTranspose2D(nn.Module):
         kh, kw = self.kernel_size
         cin = x.shape[-1]
         kernel = self.param(
-            "kernel", self.kernel_init, (kh, kw, cin, self.features)
+            "kernel",
+            self.kernel_init,
+            (kh, kw, cin // self.feature_group_count, self.features),
         )
         if x.dtype != kernel.dtype:
             kernel = kernel.astype(jnp.promote_types(x.dtype, kernel.dtype))
@@ -481,6 +486,7 @@ class ConvTranspose2D(nn.Module):
             kernel,
             strides=(1, 1),
             padding=pads,
+            feature_group_count=self.feature_group_count,
             lhs_dilation=self.strides,
         )
         if self.use_bias:

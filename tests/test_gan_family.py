@@ -287,3 +287,53 @@ def test_fedgdkd_cohort_kd_rounds_run():
     assert np.isfinite(float(m["kd_loss"]))
     ev = sim.evaluate_clients(state)
     assert 0.0 <= ev["test_acc"] <= 1.0
+
+
+def test_cohort_gan_update_matches_vmapped():
+    """The cohort-fused adversarial phase (grouped generator pyramid +
+    grouped classifier + stacked per-client-count adam) reproduces
+    vmap(build_gan_local_update) to f32 grouped-vs-vmapped round-off —
+    same per-step RNG (z / fake labels bitwise), same gating."""
+    import dataclasses
+    from fedml_tpu.data.federated import arrays_and_batch
+
+    base = tiny_cfg()
+    cfg = dataclasses.replace(
+        base,
+        data=dataclasses.replace(base.data, partition_method="hetero",
+                                 partition_alpha=0.3),
+        model=dataclasses.replace(base.model, name="cnn_small"),
+        train=dataclasses.replace(base.train, epochs=2),
+    )
+    data = tiny_data(cfg)
+    arrays, bs = arrays_and_batch(data, cfg.data)
+    gen = create_conditional_generator(10, 28, 1, nz=16, ngf=8)
+    classifier = create_model(cfg.model)
+    disc = GC.DiscHandle.from_fed_model(classifier)
+    max_n = arrays.max_client_samples
+    vm = GC.build_gan_local_update(
+        gen, disc, cfg.train, cfg.gan, bs, max_n, mode="ssgan"
+    )
+    co = GC.build_cohort_gan_update(
+        gen, classifier, cfg.train, cfg.gan, bs, max_n, cohort=4
+    )
+    gen_vars = gen.init(jax.random.key(0))
+    keys = jax.vmap(
+        lambda i: jax.random.fold_in(jax.random.key(5), i)
+    )(jnp.arange(4))
+    cls_stack = jax.vmap(classifier.init)(keys)
+    rngs = jax.vmap(
+        lambda i: jax.random.fold_in(jax.random.key(9), i)
+    )(jnp.arange(4))
+    idx, mask = arrays.idx[:4], arrays.mask[:4]
+    vg, vd, vn, vs = jax.vmap(
+        vm, in_axes=(None, 0, 0, 0, None, None, 0)
+    )(gen_vars, cls_stack, idx, mask, arrays.x, arrays.y, rngs)
+    cg, cd, cn, cs = co(
+        gen_vars, cls_stack, idx, mask, arrays.x, arrays.y, rngs
+    )
+    np.testing.assert_array_equal(np.asarray(vn), np.asarray(cn))
+    for a, b in zip(jax.tree.leaves((vg, vd, vs)),
+                    jax.tree.leaves((cg, cd, cs))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
