@@ -38,6 +38,11 @@ MSG_TYPE_S2C_INIT = 1
 MSG_TYPE_S2C_SYNC_MODEL = 2
 MSG_TYPE_C2S_RESULT = 3
 MSG_TYPE_FINISH = 4
+# Deployment readiness handshake (reference analog: the cross-silo client
+# managers' register/CONNECTION-ready flow before round 0): a client
+# process announces its receive endpoint is live; the server starts round
+# 0 once all world_size-1 clients have announced.
+MSG_TYPE_C2S_READY = 5
 
 # Well-known payload keys (reference Message.MSG_ARG_KEY_*)
 KEY_MODEL_PARAMS = "model_params"

@@ -1,0 +1,251 @@
+"""TCP pub/sub broker: the `TopicBus` interface served over a socket.
+
+The reference's MQTT backends talk to an EXTERNAL broker process via
+paho-mqtt (``fedml_core/distributed/communication/mqtt/
+mqtt_comm_manager.py:14,47-57``): the broker is what makes the pub/sub
+path cross-process. No MQTT broker exists in this environment, so this
+module provides the minimal broker a federated run needs:
+
+- :class:`BrokerDaemon` — a standalone TCP daemon (also runnable as
+  ``python -m fedml_tpu.core.transport.broker --port N``) that routes
+  PUBLISH frames to every connection SUBSCRIBEd to the topic. Like an
+  MQTT broker, it is payload-agnostic: the federated wire codec rides
+  through it untouched.
+- :class:`RemoteTopicBus` — the client side; implements the same
+  ``subscribe(topic, cb)`` / ``publish(topic, payload)`` contract as the
+  in-process :class:`~fedml_tpu.core.transport.pubsub.TopicBus`, so
+  ``PubSubTransport`` / ``PubSubBlobTransport`` run unchanged across OS
+  processes (paho analog: ``mqtt.Client`` + network-loop thread calling
+  ``on_message``).
+
+Wire protocol (both directions, length-prefixed frames)::
+
+    op(1: b"S" subscribe | b"P" publish) || u32 topic_len || topic utf-8
+        || u64 payload_len || payload
+
+Subscribe frames carry an empty payload. Delivery semantics match MQTT
+QoS 0: no retained messages, publishes to a topic with no subscriber are
+dropped (deployment readiness must therefore be handshaken above the
+transport — see :mod:`fedml_tpu.experiments.deploy`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import socket
+import struct
+import threading
+import time
+from typing import Callable
+
+_OP_SUB = b"S"
+_OP_PUB = b"P"
+_TOPIC_HDR = struct.Struct(">I")
+_PAYLOAD_HDR = struct.Struct(">Q")
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
+    buf = b""
+    while len(buf) < n:
+        try:
+            chunk = sock.recv(n - len(buf))
+        except OSError:
+            return None
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+def _read_frame(sock: socket.socket) -> tuple[bytes, str, bytes] | None:
+    op = _recv_exact(sock, 1)
+    if op is None:
+        return None
+    hdr = _recv_exact(sock, _TOPIC_HDR.size)
+    if hdr is None:
+        return None
+    (tlen,) = _TOPIC_HDR.unpack(hdr)
+    topic = _recv_exact(sock, tlen)
+    if topic is None:
+        return None
+    hdr = _recv_exact(sock, _PAYLOAD_HDR.size)
+    if hdr is None:
+        return None
+    (plen,) = _PAYLOAD_HDR.unpack(hdr)
+    payload = _recv_exact(sock, plen) if plen else b""
+    if payload is None:
+        return None
+    return op, topic.decode("utf-8"), payload
+
+
+def _frame(op: bytes, topic: str, payload: bytes = b"") -> bytes:
+    t = topic.encode("utf-8")
+    return (
+        op + _TOPIC_HDR.pack(len(t)) + t
+        + _PAYLOAD_HDR.pack(len(payload)) + payload
+    )
+
+
+class BrokerDaemon:
+    """Topic router. One reader thread per connection; writes to each
+    subscriber are serialized by a per-connection lock (a slow subscriber
+    never interleaves another's frame)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._srv = socket.create_server((host, port))
+        self._srv.settimeout(0.5)
+        self.host, self.port = self._srv.getsockname()[:2]
+        self._subs: dict[str, list[socket.socket]] = {}
+        self._wlocks: dict[socket.socket, threading.Lock] = {}
+        self._lock = threading.Lock()
+        self._stopped = threading.Event()
+        self._accept_thread: threading.Thread | None = None
+
+    def start(self) -> "BrokerDaemon":
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._accept_thread = t
+        return self
+
+    def serve_forever(self) -> None:
+        self.start()
+        self._stopped.wait()
+
+    def _accept_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            with self._lock:
+                self._wlocks[conn] = threading.Lock()
+            threading.Thread(
+                target=self._client_loop, args=(conn,), daemon=True
+            ).start()
+
+    def _client_loop(self, conn: socket.socket) -> None:
+        try:
+            while not self._stopped.is_set():
+                frame = _read_frame(conn)
+                if frame is None:
+                    return
+                op, topic, payload = frame
+                if op == _OP_SUB:
+                    with self._lock:
+                        self._subs.setdefault(topic, []).append(conn)
+                elif op == _OP_PUB:
+                    self._route(topic, payload)
+        finally:
+            self._drop(conn)
+
+    def _route(self, topic: str, payload: bytes) -> None:
+        with self._lock:
+            subs = list(self._subs.get(topic, ()))
+        data = _frame(_OP_PUB, topic, payload)
+        for s in subs:
+            with self._lock:
+                wlock = self._wlocks.get(s)
+            if wlock is None:
+                continue
+            try:
+                with wlock:
+                    s.sendall(data)
+            except OSError:
+                self._drop(s)
+
+    def _drop(self, conn: socket.socket) -> None:
+        with self._lock:
+            self._wlocks.pop(conn, None)
+            for subs in self._subs.values():
+                while conn in subs:
+                    subs.remove(conn)
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self._srv.close()
+
+
+class RemoteTopicBus:
+    """Client side of the broker: the ``TopicBus`` contract over one TCP
+    connection. Callbacks run on the bus's reader thread (paho's
+    ``loop_start`` network thread calling ``on_message``)."""
+
+    def __init__(
+        self, host: str, port: int, connect_timeout: float = 10.0
+    ):
+        retry = threading.Event()
+        self._sock = None
+        t_end = time.monotonic() + connect_timeout
+        last_err: Exception | None = None
+        while time.monotonic() < t_end:
+            try:
+                self._sock = socket.create_connection((host, port),
+                                                      timeout=5)
+                break
+            except OSError as err:  # broker may still be starting
+                last_err = err
+                retry.wait(0.2)
+        if self._sock is None:
+            raise ConnectionError(
+                f"broker {host}:{port} unreachable: {last_err}"
+            )
+        self._sock.settimeout(None)
+        self._cbs: dict[str, list[Callable[[str, bytes], None]]] = {}
+        self._lock = threading.Lock()
+        self._wlock = threading.Lock()
+        self._stopped = threading.Event()
+        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._reader.start()
+
+    def subscribe(self, topic: str, callback: Callable[[str, bytes], None]):
+        first = False
+        with self._lock:
+            cbs = self._cbs.setdefault(topic, [])
+            first = not cbs
+            cbs.append(callback)
+        if first:  # one broker-side subscription per topic per process
+            with self._wlock:
+                self._sock.sendall(_frame(_OP_SUB, topic))
+
+    def publish(self, topic: str, payload: bytes) -> None:
+        with self._wlock:
+            self._sock.sendall(_frame(_OP_PUB, topic, payload))
+
+    def _read_loop(self) -> None:
+        while not self._stopped.is_set():
+            frame = _read_frame(self._sock)
+            if frame is None:
+                return
+            _, topic, payload = frame
+            with self._lock:
+                cbs = list(self._cbs.get(topic, ()))
+            for cb in cbs:
+                cb(topic, payload)
+
+    def close(self) -> None:
+        self._stopped.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description="fedml_tpu pub/sub broker")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=29950)
+    a = p.parse_args(argv)
+    daemon = BrokerDaemon(a.host, a.port)
+    print(f"broker listening on {daemon.host}:{daemon.port}", flush=True)
+    daemon.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,340 @@
+"""Process-separated deployment: one OS process per rank, over a socket.
+
+The reference's canonical deployment is N separate OS processes —
+``mpirun -np $PROCESS_NUM`` launching a per-rank ``main_fedavg.py``
+(``fedml_experiments/distributed/fedavg/run_fedavg_distributed_pytorch.sh:1-20``)
+and the cross-silo shell launchers that start a server role and client
+roles on separate machines
+(``fedml_experiments/distributed/fedavg_cross_silo/run_server.sh``,
+``run_client.sh``). This module is that surface for the TPU framework:
+``python -m fedml_tpu.experiments.run --role server|client --rank N
+--world_size W --backend grpc|tcp|trpc|pubsub|pubsub_blob ...`` runs ONE
+rank; ``scripts/run_distributed.sh`` is the mpirun-shaped localhost
+launcher.
+
+Equality contract: every process derives its data partition, model init,
+and rng keys from the shared seeded config, and the actors are the same
+:mod:`fedml_tpu.algorithms.distributed_fedavg` /
+:mod:`fedml_tpu.algorithms.split_actors` classes whose loopback runs are
+equality-pinned against the compiled sims — so an N-process run over real
+sockets matches the compiled simulator to float round-off
+(``tests/test_deploy.py`` pins it cross-process).
+
+Readiness: socket transports have no MPI-style barrier, and the pub/sub
+path drops publishes with no subscriber (MQTT QoS-0 semantics). Clients
+therefore re-announce ``MSG_TYPE_C2S_READY`` every 0.5 s until the first
+inbound server message arrives; the server starts round 0 once all
+``world_size - 1`` distinct ranks have announced. Send failures during
+announcement (server socket not yet bound) are retried, which makes
+process launch order irrelevant — the reference gets the same property
+from MQTT broker buffering + its client "register" message.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import pickle
+import threading
+import time
+
+import jax
+import numpy as np
+
+from fedml_tpu.config import ExperimentConfig
+from fedml_tpu.core.manager import Manager, ServerManager, create_transport
+from fedml_tpu.core.message import MSG_TYPE_C2S_READY, Message
+from fedml_tpu.core.transport.base import BaseTransport
+
+FEDAVG_FAMILY = ("fedavg", "fedopt", "fednova")
+DEPLOY_ALGORITHMS = FEDAVG_FAMILY + ("splitnn",)
+
+
+@dataclasses.dataclass(frozen=True)
+class DeployConfig:
+    """One rank's deployment coordinates (the reference passes these as
+    ``--client_id/--server_ip`` flags + ``ip_config`` CSV tables,
+    ``ip_config_utils.py``)."""
+
+    role: str  # "server" | "client"
+    rank: int  # 0 = server, >=1 = client
+    world_size: int
+    backend: str = "grpc"
+    ip_config: dict[int, tuple[str, int]] | None = None
+    broker: tuple[str, int] | None = None  # pubsub* backends
+    blob_dir: str | None = None  # pubsub_blob file-backed store
+    ready_timeout: float = 120.0
+
+
+def load_ip_config(path: str) -> dict[int, tuple[str, int]]:
+    """JSON ``{"0": ["host", port], ...}`` -> rank table (the reference
+    uses CSV ``ip_config`` files; JSON keeps the one-file shape)."""
+    with open(path) as f:
+        raw = json.load(f)
+    return {int(r): (str(h), int(p)) for r, (h, p) in raw.items()}
+
+
+def _make_transport(dep: DeployConfig) -> BaseTransport:
+    backend = dep.backend.upper()
+    if backend in ("PUBSUB", "MQTT", "PUBSUB_BLOB", "MQTT_S3"):
+        from fedml_tpu.core.transport.broker import RemoteTopicBus
+        from fedml_tpu.core.transport.pubsub import BlobStore
+
+        assert dep.broker is not None, f"{dep.backend} needs --broker"
+        bus = RemoteTopicBus(*dep.broker)
+        store = None
+        if backend in ("PUBSUB_BLOB", "MQTT_S3"):
+            assert dep.blob_dir is not None, (
+                "pubsub_blob needs --blob_dir (file-backed cross-process "
+                "blob store)"
+            )
+            store = BlobStore(root=dep.blob_dir)
+        return create_transport(
+            dep.backend, dep.rank, bus=bus, store=store,
+            size=dep.world_size,
+        )
+    assert dep.ip_config is not None, f"{dep.backend} needs --ip_config"
+    return create_transport(dep.backend, dep.rank, ip_config=dep.ip_config)
+
+
+# ---------------------------------------------------------------------------
+# readiness handshake
+# ---------------------------------------------------------------------------
+
+
+def _serve_with_ready_barrier(
+    server: ServerManager, dep: DeployConfig, kickoff
+) -> None:
+    """Start round 0 once all clients have announced; then drain until the
+    actor finishes the run."""
+    ready: set[int] = set()
+    started = threading.Event()
+
+    def on_ready(msg: Message) -> None:
+        ready.add(msg.sender)
+        # duplicates arrive by design (clients re-announce until the
+        # first sync lands); kick off exactly once
+        if len(ready) >= dep.world_size - 1 and not started.is_set():
+            started.set()
+            kickoff()
+
+    server.register_message_receive_handler(MSG_TYPE_C2S_READY, on_ready)
+    server.transport.start()
+    server.run()  # blocks until the actor's finish path stops the transport
+
+
+def _announce_until_first_message(
+    mgr: Manager, dep: DeployConfig
+) -> threading.Event:
+    """Client side: re-send READY until any server message arrives.
+
+    Returns the first-inbound event; if ``ready_timeout`` expires first,
+    the loop STOPS the transport so the caller's ``run()`` unblocks — the
+    caller must then check the event and fail loudly (a silently-hung
+    client would wedge the whole launcher run)."""
+    got = threading.Event()
+
+    class _FirstInbound:
+        def receive_message(self, msg_type: int, msg: Message) -> None:
+            got.set()
+
+    mgr.transport.add_observer(_FirstInbound())
+
+    def loop() -> None:
+        deadline = time.monotonic() + dep.ready_timeout
+        while not got.is_set() and time.monotonic() < deadline:
+            try:
+                mgr.send_message(
+                    Message(MSG_TYPE_C2S_READY, mgr.rank, 0, {})
+                )
+            except Exception:
+                pass  # server endpoint not up yet — retry
+            got.wait(0.5)
+        if not got.is_set():
+            mgr.transport.stop()  # unblock run() -> caller raises
+
+    threading.Thread(target=loop, daemon=True).start()
+    return got
+
+
+def _check_contacted(got: threading.Event, dep: DeployConfig) -> None:
+    if not got.is_set():
+        raise RuntimeError(
+            f"server never contacted this client within "
+            f"--ready_timeout {dep.ready_timeout}s — is the server rank "
+            "up and reachable?"
+        )
+
+
+# ---------------------------------------------------------------------------
+# rank entrypoints
+# ---------------------------------------------------------------------------
+
+
+def _params_digest(tree) -> str:
+    h = hashlib.sha256()
+    for leaf in jax.tree.leaves(tree):
+        h.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
+    return h.hexdigest()
+
+
+def _run_dir(cfg: ExperimentConfig) -> str:
+    d = os.path.join(cfg.out_dir, cfg.run_name)
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _write_final(cfg: ExperimentConfig, tag: str, tree) -> str:
+    """Persist final variables (numpy pytree pickle — the cross-process
+    equality artifact the tests and the launcher compare)."""
+    path = os.path.join(_run_dir(cfg), f"{tag}.pkl")
+    host = jax.tree.map(np.asarray, tree)
+    with open(path, "wb") as f:
+        pickle.dump(host, f, protocol=5)
+    return path
+
+
+def _run_fedavg_rank(cfg: ExperimentConfig, dep: DeployConfig) -> dict:
+    from fedml_tpu.algorithms.distributed_fedavg import (
+        FedAvgClientActor,
+        FedAvgServerActor,
+    )
+    from fedml_tpu.data.loaders import load_dataset
+    from fedml_tpu.models import create_model
+
+    # every rank rebuilds the identical seeded dataset + partition (the
+    # reference ships the same data path to every MPI rank too,
+    # main_fedavg.py load_data before FedML_FedAvg_distributed)
+    data = load_dataset(cfg.data)
+    model = create_model(cfg.model)
+    transport = _make_transport(dep)
+
+    if dep.role == "server":
+        server = FedAvgServerActor(
+            dep.world_size, transport, model, cfg,
+            num_clients=cfg.data.num_clients, data=data,
+        )
+        _serve_with_ready_barrier(server, dep, server.start_round)
+        if not server.done.is_set():
+            raise RuntimeError(
+                f"server stopped before completing {cfg.fed.num_rounds} "
+                f"rounds (round_idx={server.round_idx})"
+            )
+        path = _write_final(cfg, "final_params", server.variables)
+        # global test metrics on the final model (reference
+        # test_on_server_for_all_clients, FedAVGAggregator.py:110-164)
+        from fedml_tpu.algorithms.base import build_evaluator, make_task
+
+        arrays = data.to_arrays(pad_multiple=cfg.data.batch_size)
+        ev = build_evaluator(model, make_task(data.task))
+        metrics = {
+            k: float(v)
+            for k, v in ev(server.variables, arrays.test_x,
+                           arrays.test_y).items()
+        }
+        return {
+            "role": "server",
+            "algorithm": cfg.fed.algorithm,
+            "backend": dep.backend,
+            "world_size": dep.world_size,
+            "rounds": server.round_idx,
+            "final_params": path,
+            "params_digest": _params_digest(server.variables),
+            **metrics,
+        }
+
+    client = FedAvgClientActor(
+        dep.rank, dep.world_size, transport, model, data, cfg
+    )
+    client.transport.start()
+    got = _announce_until_first_message(client, dep)
+    client.run()
+    _check_contacted(got, dep)
+    return {"role": "client", "rank": dep.rank, "status": "finished"}
+
+
+def _run_splitnn_rank(cfg: ExperimentConfig, dep: DeployConfig) -> dict:
+    from fedml_tpu.algorithms.split import SplitNNSim
+    from fedml_tpu.algorithms.split_actors import (
+        SplitNNClientActor,
+        SplitNNServerActor,
+    )
+    from fedml_tpu.data.loaders import load_dataset
+    from fedml_tpu.models.gkt import SplitClientNet, SplitServerNet
+
+    if dep.world_size != cfg.data.num_clients + 1:
+        raise ValueError(
+            "splitnn deployment: world_size must be num_clients+1 "
+            f"(got {dep.world_size} vs {cfg.data.num_clients}+1)"
+        )
+    data = load_dataset(cfg.data)
+    client_model = SplitClientNet()
+    server_model = SplitServerNet(num_classes=cfg.model.num_classes)
+    # the seeded sim init is the shared starting point: each rank takes
+    # only its own piece (the reference distributes initial weights by
+    # broadcast; here init is deterministic so no round-0 broadcast of
+    # the lower stacks is needed)
+    sim = SplitNNSim(client_model, server_model, data, cfg)
+    state0 = sim.init()
+    transport = _make_transport(dep)
+
+    if dep.role == "server":
+        server = SplitNNServerActor(
+            dep.world_size, transport, server_model,
+            state0.server_vars, cfg,
+        )
+        _serve_with_ready_barrier(server, dep, server.start_round)
+        if not server.done.is_set():
+            raise RuntimeError(
+                f"splitnn server stopped before completing "
+                f"{cfg.fed.num_rounds} rounds (round_idx="
+                f"{server.round_idx})"
+            )
+        path = _write_final(cfg, "final_server_params", server.server_vars)
+        return {
+            "role": "server",
+            "algorithm": "splitnn",
+            "backend": dep.backend,
+            "world_size": dep.world_size,
+            "rounds": len(server.metrics_history),
+            "final_params": path,
+            "params_digest": _params_digest(server.server_vars),
+            "metrics_history": server.metrics_history,
+        }
+
+    client = SplitNNClientActor(
+        dep.rank, dep.world_size, transport, client_model,
+        jax.tree.map(lambda s: s[dep.rank - 1], state0.client_stack),
+        data, cfg,
+    )
+    client.transport.start()
+    got = _announce_until_first_message(client, dep)
+    client.run()
+    _check_contacted(got, dep)
+    path = _write_final(
+        cfg, f"final_client{dep.rank}_params", client.c_vars
+    )
+    return {
+        "role": "client",
+        "rank": dep.rank,
+        "status": "finished",
+        "final_params": path,
+        "params_digest": _params_digest(client.c_vars),
+    }
+
+
+def run_role(cfg: ExperimentConfig, dep: DeployConfig) -> dict:
+    """Run THIS process's rank to completion; returns the rank summary."""
+    algo = cfg.fed.algorithm
+    if algo in FEDAVG_FAMILY:
+        return _run_fedavg_rank(cfg, dep)
+    if algo == "splitnn":
+        return _run_splitnn_rank(cfg, dep)
+    raise ValueError(
+        f"algorithm {algo!r} has no deployment path; deployable: "
+        f"{DEPLOY_ALGORITHMS} (every other algorithm runs via the "
+        "compiled simulator, python -m fedml_tpu.experiments.run without "
+        "--role)"
+    )

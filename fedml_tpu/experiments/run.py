@@ -28,7 +28,7 @@ from fedml_tpu.config import ExperimentConfig
 from fedml_tpu.experiments.harness import ALGORITHMS, Experiment
 
 
-def parse_args(argv=None) -> tuple[ExperimentConfig, int]:
+def parse_args(argv=None) -> tuple[ExperimentConfig, argparse.Namespace]:
     p = argparse.ArgumentParser(
         prog="fedml_tpu.experiments.run",
         description="TPU-native federated learning experiment runner",
@@ -70,6 +70,34 @@ def parse_args(argv=None) -> tuple[ExperimentConfig, int]:
                    help="checkpoint round state every N rounds into "
                         "<out_dir>/<run>/ckpt and resume from the "
                         "latest checkpoint on restart (0 = off)")
+    # -- process-separated deployment (reference mpirun/run_server.sh
+    # surface: one OS process per rank; scripts/run_distributed.sh is the
+    # localhost launcher) --------------------------------------------------
+    p.add_argument("--role", type=str, default=None,
+                   choices=["server", "client"],
+                   help="run ONE deployment rank instead of the local "
+                        "simulator (requires --world_size; clients also "
+                        "--rank)")
+    p.add_argument("--rank", type=int, default=None,
+                   help="this process's rank (server=0, clients>=1)")
+    p.add_argument("--world_size", type=int, default=None,
+                   help="total process count (1 server + N clients)")
+    p.add_argument("--backend", type=str, default="grpc",
+                   choices=["tcp", "grpc", "trpc", "pubsub", "pubsub_blob"],
+                   help="deployment transport backend")
+    p.add_argument("--ip_config", type=str, default=None,
+                   help='JSON file {"rank": ["host", port], ...} '
+                        "(tcp/grpc/trpc backends)")
+    p.add_argument("--broker", type=str, default=None,
+                   help="host:port of the pub/sub broker daemon "
+                        "(pubsub/pubsub_blob backends; start one with "
+                        "python -m fedml_tpu.core.transport.broker)")
+    p.add_argument("--blob_dir", type=str, default=None,
+                   help="shared directory for the file-backed blob store "
+                        "(pubsub_blob backend)")
+    p.add_argument("--ready_timeout", type=float, default=120.0,
+                   help="seconds a client re-announces readiness before "
+                        "giving up")
     a = p.parse_args(argv)
 
     if a.config:
@@ -123,12 +151,50 @@ def parse_args(argv=None) -> tuple[ExperimentConfig, int]:
         out_dir=a.out_dir,
         checkpoint_every=a.checkpoint_every,
     )
-    return cfg, a.repetitions
+    return cfg, a
+
+
+def _deploy_config(a) -> "DeployConfig":
+    from fedml_tpu.experiments.deploy import DeployConfig, load_ip_config
+
+    if a.world_size is None:
+        raise SystemExit("--role requires --world_size")
+    if a.world_size < 2:
+        raise SystemExit(
+            "--world_size must be >= 2 (1 server + at least 1 client); "
+            "for a single-process run drop --role and use the simulator"
+        )
+    rank = a.rank if a.rank is not None else (0 if a.role == "server" else None)
+    if rank is None:
+        raise SystemExit("--role client requires --rank >= 1")
+    if a.role == "server" and rank != 0:
+        raise SystemExit("server is always rank 0")
+    if a.role == "client" and not (1 <= rank < a.world_size):
+        raise SystemExit("client rank must be in [1, world_size)")
+    broker = None
+    if a.broker is not None:
+        host, _, port = a.broker.rpartition(":")
+        broker = (host, int(port))
+    return DeployConfig(
+        role=a.role,
+        rank=rank,
+        world_size=a.world_size,
+        backend=a.backend,
+        ip_config=load_ip_config(a.ip_config) if a.ip_config else None,
+        broker=broker,
+        blob_dir=a.blob_dir,
+        ready_timeout=a.ready_timeout,
+    )
 
 
 def main(argv=None) -> int:
-    cfg, repetitions = parse_args(argv)
-    summaries = Experiment(cfg, repetitions).run()
+    cfg, a = parse_args(argv)
+    if a.role is not None:
+        from fedml_tpu.experiments.deploy import run_role
+
+        print(json.dumps(run_role(cfg, _deploy_config(a)), default=float))
+        return 0
+    summaries = Experiment(cfg, a.repetitions).run()
     for s in summaries:
         print(json.dumps(s, default=float))
     return 0

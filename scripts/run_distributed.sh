@@ -1,0 +1,101 @@
+#!/bin/sh
+# mpirun-shaped localhost launcher: 1 server + N client OS processes.
+#
+# Reference analogs:
+#   fedml_experiments/distributed/fedavg/run_fedavg_distributed_pytorch.sh
+#     (mpirun -np $PROCESS_NUM python main_fedavg.py ...)
+#   fedml_experiments/distributed/fedavg_cross_silo/run_server.sh,
+#     run_client.sh (one role per shell invocation)
+#
+# Usage:
+#   scripts/run_distributed.sh NCLIENTS BACKEND [run.py args...]
+# e.g.
+#   scripts/run_distributed.sh 2 grpc --algorithm fedavg \
+#     --dataset fake_mnist --model lr --num_classes 10 \
+#     --input_shape 28 28 1 --client_num_in_total 2 \
+#     --client_num_per_round 2 --comm_round 3 --epochs 1 --batch_size 32
+#
+# BACKEND in {tcp, grpc, trpc, pubsub, pubsub_blob}. Socket backends get
+# a generated localhost ip_config; pub/sub backends get a broker daemon
+# launched for the run's duration (the reference assumes an external MQTT
+# broker; ours is fedml_tpu.core.transport.broker).
+#
+# Per-rank logs + the server's summary JSON land in $OUT (default
+# runs/distributed). Exit status is the server process's.
+set -e
+cd "$(dirname "$0")/.."
+
+NCLIENTS=${1:?usage: run_distributed.sh NCLIENTS BACKEND [run.py args...]}
+BACKEND=${2:?usage: run_distributed.sh NCLIENTS BACKEND [run.py args...]}
+shift 2
+WORLD=$((NCLIENTS + 1))
+OUT=${OUT:-runs/distributed}
+mkdir -p "$OUT"
+
+# free localhost ports: WORLD for socket backends + 1 for the broker
+PORTS=$(python - "$((WORLD + 1))" <<'EOF'
+import socket, sys
+socks = [socket.socket() for _ in range(int(sys.argv[1]))]
+for s in socks:
+    s.bind(("127.0.0.1", 0))
+print(" ".join(str(s.getsockname()[1]) for s in socks))
+for s in socks:
+    s.close()
+EOF
+)
+
+BROKER_PID=""
+EXTRA=""
+case "$BACKEND" in
+  pubsub|pubsub_blob)
+    BROKER_PORT=$(echo "$PORTS" | awk '{print $NF}')
+    python -m fedml_tpu.core.transport.broker --port "$BROKER_PORT" \
+      > "$OUT/broker.log" 2>&1 &
+    BROKER_PID=$!
+    EXTRA="--broker 127.0.0.1:$BROKER_PORT"
+    if [ "$BACKEND" = "pubsub_blob" ]; then
+      mkdir -p "$OUT/blobs"
+      EXTRA="$EXTRA --blob_dir $OUT/blobs"
+    fi
+    ;;
+  *)
+    python - "$WORLD" $PORTS > "$OUT/ip_config.json" <<'EOF'
+import json, sys
+world = int(sys.argv[1])
+ports = [int(p) for p in sys.argv[2:2 + world]]
+print(json.dumps({str(r): ["127.0.0.1", ports[r]] for r in range(world)}))
+EOF
+    EXTRA="--ip_config $OUT/ip_config.json"
+    ;;
+esac
+
+cleanup() {
+  [ -n "$BROKER_PID" ] && kill "$BROKER_PID" 2>/dev/null || true
+}
+trap cleanup EXIT INT TERM
+
+# clients in the background (launch order is irrelevant: the deploy
+# readiness handshake retries until the world is up)
+CLIENT_PIDS=""
+r=1
+while [ "$r" -le "$NCLIENTS" ]; do
+  python -m fedml_tpu.experiments.run "$@" \
+    --role client --rank "$r" --world_size "$WORLD" \
+    --backend "$BACKEND" $EXTRA --out_dir "$OUT" \
+    > "$OUT/client_$r.log" 2>&1 &
+  CLIENT_PIDS="$CLIENT_PIDS $!"
+  r=$((r + 1))
+done
+
+# server in the foreground; its stdout JSON is the run summary
+python -m fedml_tpu.experiments.run "$@" \
+  --role server --world_size "$WORLD" \
+  --backend "$BACKEND" $EXTRA --out_dir "$OUT" \
+  | tee "$OUT/server_summary.json"
+STATUS=$?
+# wait only the CLIENT pids — a plain `wait` would also block on the
+# broker daemon, which serves until killed by the EXIT trap
+for pid in $CLIENT_PIDS; do
+  wait "$pid" || STATUS=$?
+done
+exit $STATUS

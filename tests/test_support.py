@@ -155,7 +155,7 @@ def test_experiment_harness_and_cli(tmp_path):
 def test_cli_parse_args():
     from fedml_tpu.experiments.run import parse_args
 
-    cfg, reps = parse_args([
+    cfg, args = parse_args([
         "--algorithm", "fedavg", "--dataset", "synthetic_1_1",
         "--model", "lr", "--num_classes", "10", "--input_shape", "60",
         "--comm_round", "3", "--client_num_in_total", "5",
@@ -167,7 +167,8 @@ def test_cli_parse_args():
     assert cfg.data.num_clients == 5
     assert cfg.model.input_shape == (60,)
     assert cfg.train.lr == 0.1
-    assert reps == 2
+    assert args.repetitions == 2
+    assert args.role is None  # no --role => local simulator path
 
 
 def test_per_client_observability_sink():
