@@ -26,6 +26,14 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Honor JAX_PLATFORMS even on hosts whose sitecustomize pins the platform
+# via jax.config (same escape hatch as experiments/run.py) — e.g.
+# JAX_PLATFORMS=cpu runs the battery without the TPU tunnel.
+if os.environ.get("JAX_PLATFORMS"):
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
 BATTERY_ALGORITHMS = (
     # the Makefile's run-example-experiments list, in its order
     "baseline", "centralized", "fedavg", "fedmd", "fd_faug", "feddtg",

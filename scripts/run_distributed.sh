@@ -87,12 +87,15 @@ while [ "$r" -le "$NCLIENTS" ]; do
   r=$((r + 1))
 done
 
-# server in the foreground; its stdout JSON is the run summary
+# server in the foreground; its stdout JSON is the run summary. No
+# pipeline here: POSIX sh has no pipefail, and `... | tee` would report
+# tee's status instead of the server's
+STATUS=0
 python -m fedml_tpu.experiments.run "$@" \
   --role server --world_size "$WORLD" \
   --backend "$BACKEND" $EXTRA --out_dir "$OUT" \
-  | tee "$OUT/server_summary.json"
-STATUS=$?
+  > "$OUT/server_summary.json" || STATUS=$?
+cat "$OUT/server_summary.json"
 # wait only the CLIENT pids — a plain `wait` would also block on the
 # broker daemon, which serves until killed by the EXIT trap
 for pid in $CLIENT_PIDS; do

@@ -65,7 +65,7 @@ def _spawn_world(tmp_path, cfg, world, backend, extra=()):
     cfg_path = tmp_path / "cfg.json"
     cfg_path.write_text(json.dumps(cfg))
     args = ["--config", str(cfg_path), "--backend", backend,
-            "--world_size", str(world), *extra]
+            "--world_size", str(world), "--ready_timeout", "60", *extra]
     if backend in ("tcp", "grpc", "trpc"):
         ports = _free_ports(world)
         ip_path = tmp_path / "ip.json"
@@ -90,7 +90,10 @@ def _spawn_world(tmp_path, cfg, world, backend, extra=()):
     )
     try:
         s_out, s_err = server.communicate(timeout=300)
-        outs = [p.communicate(timeout=60)[0] for p in procs]
+        # longer than the clients' --ready_timeout (60 s): a server
+        # failure must surface as the AssertionError below WITH the
+        # captured logs, not as an opaque TimeoutExpired here
+        outs = [p.communicate(timeout=120)[0] for p in procs]
     except subprocess.TimeoutExpired:
         server.kill()
         for p in procs:

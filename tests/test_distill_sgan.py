@@ -93,6 +93,37 @@ def test_fd_rounds_and_teacher_exchange():
     assert 0.0 <= ev["test_acc"] <= 1.0
 
 
+def test_fd_kd_term_alters_update():
+    """Regression for VERDICT r4 weak #2 (FD+FAug == baseline in the
+    battery): the KD term must measurably CHANGE training once teachers
+    exist — a dead exchange path would make gamma irrelevant. Round 1
+    trains with no teacher (identical across gammas by construction);
+    from round 2 the distillation term must move the weights."""
+
+    def two_rounds(gamma):
+        cfg = tiny_cfg(kd_gamma=gamma)
+        data = tiny_data(cfg)
+        sim = FDSim(create_model(cfg.model), data, cfg)
+        state = sim.init()
+        for _ in range(2):
+            state, _ = sim.run_round(state)
+        return state
+
+    s_off, s_on = two_rounds(0.0), two_rounds(0.5)
+    # the exchange produced a real (non-uniform-softmax) teacher
+    assert bool(jnp.any(s_on.has_teacher))
+    assert float(jnp.max(jnp.abs(s_on.teacher))) > 1e-3
+    # and that teacher altered the round-2 local updates
+    diffs = [
+        float(jnp.max(jnp.abs(a - b)))
+        for a, b in zip(jax.tree.leaves(s_off.model_stack),
+                        jax.tree.leaves(s_on.model_stack))
+    ]
+    assert max(diffs) > 1e-6, (
+        "kd_gamma had no effect on training: KD path is dead"
+    )
+
+
 def test_fd_loo_label_average_math():
     # 2 clients, 2 classes: client teachers must exclude their own stats
     lab_avg = np.array(
