@@ -169,21 +169,29 @@ def _check_contacted(got: threading.Event, dep: DeployConfig) -> None:
 
 
 def _run_client_with_liveness(
-    mgr: Manager, dep: DeployConfig, idle_probe_s: float = 15.0
+    mgr: Manager,
+    dep: DeployConfig,
+    got: threading.Event,
+    idle_probe_s: float = 15.0,
 ) -> None:
     """Drain the client's inbox until FINISH, probing server liveness on
     idle windows: a server that dies MID-run sends nothing, and a plain
     ``run()`` would block on the inbox forever. On each idle window we
     re-send READY (the server's ready-barrier handler tolerates
     duplicates); a dead server endpoint makes the send raise on socket
-    backends, which we convert to a loud failure. Pub/sub limitation:
-    with the broker alive a publish to a dead server succeeds silently
-    (MQTT QoS-0), so only broker death is detectable there."""
+    backends, which we convert to a loud failure. BEFORE the first
+    server contact (``got`` unset) probe failures are expected — the
+    server may simply not have bound yet — so liveness enforcement only
+    arms once contact is established; until then launch-order tolerance
+    belongs to :func:`_announce_until_first_message`'s ready_timeout.
+    Pub/sub limitation: with the broker alive a publish to a dead
+    server succeeds silently (MQTT QoS-0), so only broker death is
+    detectable there."""
     mgr.transport.start()
     while not mgr.transport._stopped.is_set():
         mgr.transport.handle_receive_message(timeout=idle_probe_s)
-        if mgr.transport._stopped.is_set():
-            break
+        if mgr.transport._stopped.is_set() or not got.is_set():
+            continue  # stopped -> loop exits; pre-contact -> no probe
         try:  # idle window: is the server endpoint still there?
             mgr.send_message(Message(MSG_TYPE_C2S_READY, mgr.rank, 0, {}))
         except Exception as err:
@@ -275,7 +283,7 @@ def _run_fedavg_rank(cfg: ExperimentConfig, dep: DeployConfig) -> dict:
     )
     client.transport.start()
     got = _announce_until_first_message(client, dep)
-    _run_client_with_liveness(client, dep)
+    _run_client_with_liveness(client, dep, got)
     _check_contacted(got, dep)
     return {"role": "client", "rank": dep.rank, "status": "finished"}
 
@@ -336,7 +344,7 @@ def _run_splitnn_rank(cfg: ExperimentConfig, dep: DeployConfig) -> dict:
     )
     client.transport.start()
     got = _announce_until_first_message(client, dep)
-    _run_client_with_liveness(client, dep)
+    _run_client_with_liveness(client, dep, got)
     _check_contacted(got, dep)
     path = _write_final(
         cfg, f"final_client{dep.rank}_params", client.c_vars

@@ -1,0 +1,21 @@
+#!/bin/sh
+# Probe the TPU tunnel every 5 min; when it answers, immediately run the
+# 5-repetition battery (VERDICT r5 item 2) on it, then exit. The probe
+# runs in a subprocess with a hard timeout because a wedged tunnel blocks
+# jax backend init indefinitely.
+cd "$(dirname "$0")/.."
+while :; do
+  if timeout 120 python -c "
+import jax, jax.numpy as jnp
+assert jax.devices()[0].platform != 'cpu'
+print(float(jnp.ones(8).sum()))" >/dev/null 2>&1; then
+    echo "$(date +%H:%M:%S) TPU is back; starting 5-rep battery"
+    rm -rf runs/battery_r5
+    python scripts/run_battery.py --reps 5 --out runs/battery_r5 \
+      > runs/battery_r5.log 2>&1
+    echo "$(date +%H:%M:%S) battery finished rc=$?"
+    exit 0
+  fi
+  echo "$(date +%H:%M:%S) TPU still unreachable"
+  sleep 300
+done

@@ -263,15 +263,21 @@ def test_gkt_actors_match_sim(backend, port):
     # optimizer steps over the received banks) amplifies it to ~3.4e-4
     # abs on the server weights and ~2.9e-4 abs on the teacher-logit
     # bank. atol carries the bound (near-zero weights make pure rtol
-    # meaningless); 2e-3 gives ~6x margin over measured.
-    _close(server.server_vars, state.server_vars, rtol=1e-2, atol=2e-3)
+    # meaningless); 2e-3 gives ~6x margin over measured. These bounds
+    # are CALIBRATED FOR CPU (the only platform the suite runs on —
+    # conftest pins it); on an accelerator the vmap-vs-unbatched BN
+    # fusion divergence seeds at ~4e-5 and amplifies to ~0.2 abs, so
+    # widen accordingly rather than chasing flakes.
+    plat = jax.devices()[0].platform
+    w_atol, l_atol = (2e-3, 1e-2) if plat == "cpu" else (2e-2, 0.3)
+    _close(server.server_vars, state.server_vars, rtol=1e-2, atol=w_atol)
     np.testing.assert_allclose(
         np.asarray(server.server_logits),
-        np.asarray(state.server_logits), rtol=1e-2, atol=1e-2,
+        np.asarray(state.server_logits), rtol=1e-2, atol=l_atol,
     )
     for i, cv in enumerate(client_vars):
         _close(cv, jax.tree.map(lambda s: s[i], state.client_stack),
-               rtol=1e-2, atol=2e-3)
+               rtol=1e-2, atol=w_atol)
 
     def composed_acc(c_vars, s_vars):
         f, _ = sim._client_apply_eval(c_vars, sim.arrays.test_x)
