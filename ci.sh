@@ -23,6 +23,10 @@ OUT=$(mktemp -d)
 echo "== 1/3 fast test tier =="
 python -m pytest tests -m "not slow" -q -x -p no:cacheprovider
 
+# doc perf tables must match the bench artifact (generated, never
+# hand-edited; skips cleanly when no artifact exists on a fresh clone)
+python scripts/render_perf_tables.py --check
+
 echo "== 2/3 smoke matrix (tiny runs) =="
 # one process for the whole matrix: same CLI argv surface via
 # run.main(argv), but jax/backend startup and compile caches paid once
