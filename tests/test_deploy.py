@@ -164,14 +164,62 @@ def test_cross_process_fedavg_grpc_matches_sim(tmp_path):
 
 
 @pytest.mark.slow
-def test_cross_process_fedavg_3proc_tcp_matches_sim(tmp_path):
-    """1 server + 2 clients as separate OS processes over raw TCP."""
+@pytest.mark.parametrize("backend", ["tcp", "trpc"])
+def test_cross_process_fedavg_3proc_matches_sim(tmp_path, backend):
+    """1 server + 2 clients as separate OS processes over raw TCP and
+    the tensor-native RPC framing."""
     cfg_d = _cfg_dict(tmp_path, "fedavg", num_clients=2, rounds=2)
-    summary = _spawn_world(tmp_path, cfg_d, world=3, backend="tcp")
+    summary = _spawn_world(tmp_path, cfg_d, world=3, backend=backend)
     assert summary["rounds"] == 2
     with open(summary["final_params"], "rb") as f:
         got = pickle.load(f)
     _assert_close(got, _fedavg_sim_final(cfg_d))
+
+
+@pytest.mark.slow
+def test_cross_process_fedopt_adam_grpc(tmp_path):
+    """The server-optimizer family deploys too: FedOpt(adam) across OS
+    processes must match an in-process actor run over loopback (the
+    loopback actors are themselves pinned to the compiled sim's
+    server_update, so this transitively pins the full chain)."""
+    import jax.numpy as jnp
+    import threading
+
+    from fedml_tpu.algorithms.distributed_fedavg import (
+        FedAvgClientActor,
+        FedAvgServerActor,
+    )
+    from fedml_tpu.config import ExperimentConfig
+    from fedml_tpu.core.transport.loopback import LoopbackHub
+    from fedml_tpu.data.loaders import load_dataset
+    from fedml_tpu.models import create_model
+
+    cfg_d = _cfg_dict(tmp_path, "fedopt", num_clients=2, rounds=2)
+    cfg_d["fed"]["server_optimizer"] = "adam"
+    cfg_d["fed"]["server_lr"] = 1e-2
+    summary = _spawn_world(tmp_path, cfg_d, world=3, backend="grpc")
+    assert summary["rounds"] == 2
+    with open(summary["final_params"], "rb") as f:
+        got = pickle.load(f)
+
+    cfg = ExperimentConfig.from_dict(cfg_d)
+    data = load_dataset(cfg.data)
+    model = create_model(cfg.model)
+    hub = LoopbackHub()
+    server = FedAvgServerActor(3, hub.create(0), model, cfg,
+                               num_clients=2, data=data)
+    clients = [FedAvgClientActor(r, 3, hub.create(r), model, data, cfg)
+               for r in (1, 2)]
+    threads = [threading.Thread(target=c.run, daemon=True)
+               for c in clients]
+    for t in threads:
+        t.start()
+    server.start_round()
+    server.run()
+    assert server.done.wait(timeout=30)
+    for t in threads:
+        t.join(timeout=10)
+    _assert_close(got, jax.tree.map(lambda v: v, server.variables))
 
 
 @pytest.mark.slow
