@@ -1240,6 +1240,47 @@ def main():
                          "cap)")
     args = ap.parse_args()
 
+    # Fail FAST if the device backend cannot come up: a wedged TPU
+    # tunnel blocks jax backend init forever with no error (observed
+    # r5: jax.devices() sleep-retries indefinitely while another client
+    # holds the chip or the tunnel is down). Probe in a subprocess with
+    # a hard timeout so a dead tunnel yields a diagnosable nonzero exit
+    # instead of an infinite hang.
+    import subprocess
+
+    # the probe replicates the platform selection bench itself uses
+    # (honor JAX_PLATFORMS even though sitecustomize pins the platform
+    # via jax.config — same escape hatch as experiments/run.py)
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    _probe_src = (
+        "import os, jax\n"
+        "if os.environ.get('JAX_PLATFORMS'):\n"
+        "    jax.config.update('jax_platforms',"
+        " os.environ['JAX_PLATFORMS'])\n"
+        "jax.devices()\n"
+    )
+    try:
+        subprocess.run(
+            [sys.executable, "-c", _probe_src],
+            timeout=300, capture_output=True, check=True,
+        )
+    except subprocess.TimeoutExpired:
+        print(
+            "[bench] FATAL: jax backend did not initialize within 300s "
+            "— the TPU tunnel is down or another process holds the "
+            "chip. No measurements were taken.", file=sys.stderr,
+            flush=True,
+        )
+        sys.exit(3)
+    except subprocess.CalledProcessError as err:
+        print(f"[bench] FATAL: jax backend init failed: "
+              f"{err.stderr.decode(errors='replace')[-500:]}",
+              file=sys.stderr, flush=True)
+        sys.exit(3)
+
     _enable_compile_cache()
     t_start = time.perf_counter()
 

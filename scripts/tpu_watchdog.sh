@@ -3,8 +3,16 @@
 # 5-repetition battery (VERDICT r5 item 2) on it, then exit. The probe
 # runs in a subprocess with a hard timeout because a wedged tunnel blocks
 # jax backend init indefinitely.
+#
+# DEADLINE=<epoch seconds> (optional): never START the battery after
+# this time — the tunnel admits one client at a time, so a battery
+# straddling the driver's end-of-round bench would block it.
 cd "$(dirname "$0")/.."
 while :; do
+  if [ -n "${DEADLINE:-}" ] && [ "$(date +%s)" -gt "$DEADLINE" ]; then
+    echo "$(date +%H:%M:%S) deadline passed; exiting without battery"
+    exit 1
+  fi
   if timeout 120 python -c "
 import jax, jax.numpy as jnp
 assert jax.devices()[0].platform != 'cpu'
