@@ -13,6 +13,8 @@ trains the partition of client ``cohort[i-1]`` each round.
 
 from __future__ import annotations
 
+import dataclasses
+import math
 import threading
 from typing import Callable
 
@@ -45,9 +47,52 @@ from fedml_tpu.core import random as RND
 from fedml_tpu.models.base import FedModel
 
 
+@dataclasses.dataclass(frozen=True)
+class RoundPolicy:
+    """Straggler tolerance for the actor-based server (Server Averaging
+    for FL, arxiv 2103.11619: a server that makes progress from whatever
+    subset of updates actually arrives).
+
+    - ``quorum_fraction``: fraction of the round's LIVE workers whose
+      results suffice to close the round once the deadline fires
+      (aggregation weights renormalize over the survivors — the weighted
+      mean divides by the survivors' sample mass). 1.0 + no deadline ==
+      the strict everyone-reports behavior, byte-identical to the
+      compiled simulator.
+    - ``round_deadline_s``: wall-clock budget per round. When it expires
+      with quorum met, the round closes without the stragglers; without
+      quorum, the run aborts with a diagnostic instead of hanging.
+      ``None`` disables the deadline (crashed peers are still handled
+      via the heartbeat dead-peer callback).
+    """
+
+    quorum_fraction: float = 1.0
+    round_deadline_s: float | None = None
+
+    def __post_init__(self):
+        if not (0.0 < self.quorum_fraction <= 1.0):
+            raise ValueError(
+                f"quorum_fraction must be in (0, 1], "
+                f"got {self.quorum_fraction}"
+            )
+        if self.round_deadline_s is not None and self.round_deadline_s <= 0:
+            raise ValueError(
+                f"round_deadline_s must be positive or None, "
+                f"got {self.round_deadline_s}"
+            )
+
+
+class QuorumLostError(RuntimeError):
+    """The server could not assemble a quorum of client results (too many
+    crashed/straggling ranks). Carries the server's diagnostic."""
+
+
 class FedAvgServerActor(ServerManager):
     """Rank-0 aggregator (reference ``FedAVGServerManager`` +
-    ``FedAVGAggregator``)."""
+    ``FedAVGAggregator``) with straggler-tolerant rounds: the round
+    closes when every live worker reports, when the deadline fires with
+    a quorum of results in hand, or aborts loudly when the quorum is
+    unreachable — the server never blocks forever on a crashed client."""
 
     def __init__(
         self,
@@ -61,6 +106,7 @@ class FedAvgServerActor(ServerManager):
         steps_per_epoch: int | None = None,
         batch_size: int | None = None,
         data: FederatedData | None = None,
+        round_policy: RoundPolicy | None = None,
     ):
         super().__init__(0, size, transport)
         self.cfg = cfg
@@ -121,6 +167,12 @@ class FedAvgServerActor(ServerManager):
         self._lock = threading.Lock()
         self.on_round_done = on_round_done
         self.done = threading.Event()
+        self.round_policy = (
+            round_policy if round_policy is not None else RoundPolicy()
+        )
+        self.dead_peers: set[int] = set()
+        self.failure: str | None = None  # quorum-lost diagnostic
+        self._deadline_timer: threading.Timer | None = None
         self.register_message_receive_handler(
             MSG_TYPE_C2S_RESULT, self._handle_result
         )
@@ -141,9 +193,25 @@ class FedAvgServerActor(ServerManager):
         rng = np.random.default_rng(self.round_idx)
         return rng.choice(self.num_clients, n_workers, replace=False)
 
+    # -- straggler accounting (all under self._lock) -----------------------
+
+    def _live_workers(self) -> list[int]:
+        return [
+            r for r in range(1, self.size) if r not in self.dead_peers
+        ]
+
+    def _quorum(self) -> int:
+        """Results required to close the round at the deadline: a
+        fraction of the CURRENTLY live workers, never below 1 (a death
+        detected mid-round shrinks the quorum with the cohort)."""
+        live = len(self._live_workers())
+        return max(1, math.ceil(self.round_policy.quorum_fraction * live))
+
     def start_round(self) -> None:
         cohort = self._sample()
         host_vars = jax.tree.map(np.asarray, self.variables)
+        with self._lock:
+            ranks = self._live_workers()
         self.broadcast(
             MSG_TYPE_S2C_SYNC_MODEL,
             lambda r: {
@@ -151,22 +219,124 @@ class FedAvgServerActor(ServerManager):
                 KEY_CLIENT_INDEX: int(cohort[(r - 1) % len(cohort)]),
                 KEY_ROUND: self.round_idx,
             },
+            ranks=ranks,
+            on_send_error=self._on_sync_send_failed,
         )
+        if self.round_policy.round_deadline_s is not None:
+            t = threading.Timer(
+                self.round_policy.round_deadline_s,
+                self._on_round_deadline,
+                args=(self.round_idx,),
+            )
+            t.daemon = True
+            self._deadline_timer = t
+            t.start()
+
+    def _on_sync_send_failed(self, rank: int, err: Exception) -> None:
+        """A model sync that cannot be shipped == a crashed worker; the
+        round proceeds without it rather than aborting the broadcast."""
+        self.on_peer_dead(rank)
+
+    def on_peer_dead(self, rank: int) -> None:
+        """Dead-peer callback (heartbeat monitor / failed sends). Safe to
+        call from any thread, idempotent per rank."""
+        with self._lock:
+            if rank in self.dead_peers or self.done.is_set():
+                return
+            self.dead_peers.add(rank)
+            self._results.pop(rank, None)  # a dead rank's result is void
+        self._maybe_close_round(deadline_fired=False)
+
+    def _on_round_deadline(self, round_idx: int) -> None:
+        self._maybe_close_round(deadline_fired=True,
+                                deadline_round=round_idx)
+
+    def _abort_locked(self, why: str) -> None:
+        """Record the abort decision. Must run under ``self._lock`` so a
+        straggler result racing the deadline cannot both close the round
+        and see the run aborted; the FINISH broadcast happens after the
+        lock is released (it takes no shared state)."""
+        self.failure = why
+
+    def _maybe_close_round(
+        self, deadline_fired: bool, deadline_round: int | None = None
+    ) -> None:
+        """Close the round if its exit condition holds: every live worker
+        reported (zero-fault path — byte-identical to the strict
+        behavior), or the deadline fired with >= quorum results. Aborts
+        when no live worker remains or the deadline passes under quorum.
+        The round index advances under the SAME lock that claims the
+        result set, so a result racing the close is correctly classified
+        as a stale straggler rather than leaking into the next round; a
+        deadline timer carries its own round (``deadline_round``) and is
+        re-validated under that lock, so a timer firing just as its round
+        closes cannot apply deadline semantics to the NEXT round."""
+        with self._lock:
+            if self.done.is_set() or self.failure is not None:
+                return
+            if deadline_round is not None and (
+                deadline_round != self.round_idx
+            ):
+                return  # stale timer: its round already closed
+            live = self._live_workers()
+            n_results = len(self._results)
+            quorum = self._quorum()
+            abort = results = None
+            if not live:
+                abort = (
+                    f"all {self.size - 1} workers died before round "
+                    f"{self.round_idx} closed"
+                )
+            elif n_results >= len(live) or (
+                deadline_fired and n_results >= quorum
+            ):
+                results, self._results = self._results, {}
+                self.round_idx += 1
+                if self._deadline_timer is not None:
+                    self._deadline_timer.cancel()
+                    self._deadline_timer = None
+            elif deadline_fired:
+                abort = (
+                    f"round {self.round_idx} deadline "
+                    f"({self.round_policy.round_deadline_s}s) expired "
+                    f"with {n_results}/{len(live)} live results "
+                    f"(quorum {quorum}; dead peers "
+                    f"{sorted(self.dead_peers)})"
+                )
+            else:
+                return  # stragglers may still arrive before the deadline
+            if abort is not None:
+                self._abort_locked(abort)
+        if abort is not None:
+            self.finish_all()  # done unset: deploy raises the diagnostic
+        else:
+            self._close_round(results)
 
     def _handle_result(self, msg: Message) -> None:
         with self._lock:
+            if self.done.is_set() or self.failure is not None:
+                return
+            msg_round = msg.get(KEY_ROUND)
+            # a straggler's result from an already-closed round must not
+            # leak into the current aggregate (untagged results predate
+            # round-tagging and are accepted for compatibility)
+            if msg_round is not None and int(msg_round) != self.round_idx:
+                return
+            if msg.sender in self.dead_peers:
+                return  # declared dead; its late result is void
             self._results[msg.sender] = (
                 msg.get(KEY_MODEL_PARAMS),
                 float(msg.get(KEY_NUM_SAMPLES)),
             )
-            if len(self._results) < self.size - 1:
-                return
-            results = self._results
-            self._results = {}
-        # all received: aggregate through the SAME server_update as the
-        # compiled sim (reference handle_message_receive_model_from_client,
-        # FedAvgServerManager.py:45-82 + fedopt/FedOptAggregator.py) — the
-        # two paths cannot drift
+        self._maybe_close_round(deadline_fired=False)
+
+    def _close_round(self, results: dict[int, tuple[dict, float]]) -> None:
+        """Aggregate ``results`` through the SAME server_update as the
+        compiled sim (reference handle_message_receive_model_from_client,
+        FedAvgServerManager.py:45-82 + fedopt/FedOptAggregator.py) — the
+        two paths cannot drift. With a partial cohort the weighted mean
+        renormalizes over the survivors' sample counts by construction.
+        ``round_idx`` was already advanced by the caller under the lock."""
         stacked = T.tree_stack(
             [results[r][0] for r in sorted(results)]
         )
@@ -183,9 +353,14 @@ class FedAvgServerActor(ServerManager):
             rkey,
             local_reducer(),
         )
-        self.round_idx += 1
         if self.on_round_done is not None:
-            self.on_round_done(self.round_idx, {"num_results": len(results)})
+            self.on_round_done(
+                self.round_idx,
+                {
+                    "num_results": len(results),
+                    "dead_peers": sorted(self.dead_peers),
+                },
+            )
         if self.round_idx >= self.cfg.fed.num_rounds:
             self.done.set()
             self.finish_all()
@@ -243,6 +418,9 @@ class FedAvgClientActor(ClientManager):
                 {
                     KEY_MODEL_PARAMS: jax.tree.map(np.asarray, new_vars),
                     KEY_NUM_SAMPLES: float(n_k),
+                    # round tag: lets the server discard a straggler's
+                    # result that arrives after its round already closed
+                    KEY_ROUND: round_idx,
                 },
             )
         )

@@ -10,12 +10,115 @@ here it's a cooperative FINISH broadcast + transport stop.
 
 from __future__ import annotations
 
-from typing import Callable
+import threading
+import time
+from typing import Callable, Iterable
 
-from fedml_tpu.core.message import MSG_TYPE_FINISH, Message
+from fedml_tpu.core.message import (
+    MSG_TYPE_FINISH,
+    MSG_TYPE_HEARTBEAT,
+    MSG_TYPE_S2C_ACK,
+    Message,
+)
 from fedml_tpu.core.transport.base import BaseTransport
 
 Handler = Callable[[Message], None]
+
+
+class LivenessMonitor:
+    """Per-peer heartbeat sender + staleness detector.
+
+    The reference framework has NO liveness layer: a crashed MPI rank
+    aborts the world, and a crashed cross-silo client leaves the server
+    blocked in its recv loop forever. Here every manager can arm a
+    monitor: a daemon thread beats ``MSG_TYPE_HEARTBEAT`` to each peer
+    every ``interval_s`` and declares a peer dead — once — when nothing
+    has been DELIVERED from it for ``timeout_s``. Arrival time is
+    recorded by a transport deliver-hook, not at dispatch, so a peer busy
+    inside a long handler (local training) still observes heartbeats.
+    """
+
+    def __init__(
+        self,
+        mgr: "Manager",
+        peers: Iterable[int],
+        interval_s: float,
+        timeout_s: float,
+        on_dead: Callable[[int], None] | None,
+    ):
+        self.mgr = mgr
+        self.peers = list(peers)
+        self.interval_s = interval_s
+        self.timeout_s = timeout_s
+        self.on_dead = on_dead
+        self.dead: set[int] = set()
+        now = time.monotonic()
+        self.last_seen: dict[int, float] = {p: now for p in self.peers}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        mgr.transport.add_deliver_hook(self._on_deliver)
+        # ONE thread per peer: a beat to a dead peer blocks inside the
+        # transport's retry budget, and a shared loop would let a single
+        # crashed rank starve every other peer of beats (whose own
+        # watchdogs would then fire — a cascade that turns one failure
+        # into a world failure)
+        self._threads = [
+            threading.Thread(
+                target=self._run_peer, args=(p,), daemon=True,
+                name=f"liveness-rank{mgr.rank}-peer{p}",
+            )
+            for p in self.peers
+        ]
+        for t in self._threads:
+            t.start()
+
+    def _on_deliver(self, msg: Message) -> None:
+        with self._lock:
+            if msg.sender in self.last_seen:
+                self.last_seen[msg.sender] = time.monotonic()
+
+    def _mark_dead(self, peer: int) -> None:
+        with self._lock:
+            if peer in self.dead:
+                return
+            self.dead.add(peer)
+        if self.on_dead is not None:
+            self.on_dead(peer)
+
+    def _run_peer(self, peer: int) -> None:
+        while not self._stop.wait(self.interval_s):
+            if self.mgr.transport._stopped.is_set():
+                return  # actor finished without an explicit stop()
+            with self._lock:
+                if peer in self.dead:
+                    return
+                stale = (
+                    time.monotonic() - self.last_seen[peer]
+                    > self.timeout_s
+                )
+            if stale:
+                self._mark_dead(peer)
+                return
+            try:
+                self.mgr.send_message(
+                    Message(MSG_TYPE_HEARTBEAT, self.mgr.rank, peer, {})
+                )
+            except Exception:
+                # endpoint gone (socket transports raise once the
+                # retry budget is spent); pub/sub QoS-0 publishes
+                # never raise for a dead PEER — there staleness is
+                # the only detector. A send aborted because WE are
+                # shutting down (stop event cut the retry short) is
+                # not evidence about the peer — don't turn a clean
+                # finish into a spurious dead-peer failure.
+                if (self._stop.is_set()
+                        or self.mgr.transport._stopped.is_set()):
+                    return
+                self._mark_dead(peer)
+                return
+
+    def stop(self) -> None:
+        self._stop.set()
 
 
 def create_transport(
@@ -72,9 +175,19 @@ class Manager:
         self.size = size
         self.transport = transport
         self._handlers: dict[int, Handler] = {}
+        self.liveness: LivenessMonitor | None = None
         transport.add_observer(self)
         self.register_message_receive_handler(
             MSG_TYPE_FINISH, lambda msg: self.finish()
+        )
+        # liveness/handshake beacons are protocol-level: every actor
+        # accepts them silently (their side effect — the last-seen
+        # refresh — happens at deliver time, before dispatch)
+        self.register_message_receive_handler(
+            MSG_TYPE_HEARTBEAT, lambda msg: None
+        )
+        self.register_message_receive_handler(
+            MSG_TYPE_S2C_ACK, lambda msg: None
         )
 
     def register_message_receive_handler(
@@ -93,25 +206,65 @@ class Manager:
     def send_message(self, msg: Message) -> None:
         self.transport.send_message(msg)
 
+    def enable_liveness(
+        self,
+        peers: Iterable[int],
+        interval_s: float = 2.0,
+        timeout_s: float = 30.0,
+        on_dead: Callable[[int], None] | None = None,
+    ) -> LivenessMonitor:
+        """Arm the heartbeat protocol toward ``peers``. ``on_dead(rank)``
+        fires exactly once per peer, from the monitor thread."""
+        if self.liveness is not None:
+            raise RuntimeError("liveness already enabled")
+        self.liveness = LivenessMonitor(
+            self, peers, interval_s, timeout_s, on_dead
+        )
+        return self.liveness
+
     def run(self) -> None:
         self.transport.handle_receive_message()
 
     def finish(self) -> None:
+        if self.liveness is not None:
+            self.liveness.stop()
         self.transport.stop()
 
 
 class ServerManager(Manager):
     """Rank-0 actor (reference ``server_manager.py:15``)."""
 
-    def broadcast(self, msg_type: int, payload_fn) -> None:
+    def broadcast(
+        self,
+        msg_type: int,
+        payload_fn,
+        ranks: Iterable[int] | None = None,
+        on_send_error: Callable[[int, Exception], None] | None = None,
+    ) -> None:
         """Send ``Message(msg_type, 0, r, payload_fn(r))`` to every client
-        rank 1..size-1."""
-        for r in range(1, self.size):
-            self.send_message(Message(msg_type, self.rank, r, payload_fn(r)))
+        rank 1..size-1 (or just ``ranks``). With ``on_send_error`` a
+        failed send is reported per-rank instead of aborting the whole
+        broadcast — the fault-tolerant round path treats it as a dead
+        peer and keeps the cohort's survivors moving."""
+        targets = range(1, self.size) if ranks is None else ranks
+        for r in targets:
+            msg = Message(msg_type, self.rank, r, payload_fn(r))
+            if on_send_error is None:
+                self.send_message(msg)
+                continue
+            try:
+                self.send_message(msg)
+            except Exception as err:
+                on_send_error(r, err)
 
     def finish_all(self) -> None:
         for r in range(1, self.size):
-            self.send_message(Message(MSG_TYPE_FINISH, self.rank, r, {}))
+            try:
+                self.send_message(
+                    Message(MSG_TYPE_FINISH, self.rank, r, {})
+                )
+            except Exception:
+                pass  # peer already gone — FINISH is best-effort
         self.finish()
 
 

@@ -43,6 +43,16 @@ MSG_TYPE_FINISH = 4
 # process announces its receive endpoint is live; the server starts round
 # 0 once all world_size-1 clients have announced.
 MSG_TYPE_C2S_READY = 5
+# Server's reply to each READY: proves the control channel is live in BOTH
+# directions without waiting for the first work message (a later-rank
+# SplitNN client may legitimately sit idle for minutes while predecessors
+# train — liveness must not be inferred from work traffic).
+MSG_TYPE_S2C_ACK = 6
+# Liveness beacon (either direction). Carries no payload; the receiving
+# manager's per-peer last-seen table is refreshed by ANY inbound message
+# at transport-deliver time, so heartbeats only matter on otherwise-idle
+# links. See docs/FAULT_TOLERANCE.md.
+MSG_TYPE_HEARTBEAT = 7
 
 # Well-known payload keys (reference Message.MSG_ARG_KEY_*)
 KEY_MODEL_PARAMS = "model_params"

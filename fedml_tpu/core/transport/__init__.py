@@ -8,7 +8,10 @@ and provides:
 - ``LoopbackTransport`` — in-memory, for tests (the reference lacks this);
 - ``TcpTransport``     — length-prefixed frames over sockets (DCN-class
   cross-host control plane);
-- ``GrpcTransport``    — grpc bytes-RPC (no protoc needed).
+- ``GrpcTransport``    — grpc bytes-RPC (no protoc needed);
+- ``ChaosTransport``   — seeded deterministic fault injection over any of
+  the above (docs/FAULT_TOLERANCE.md); the real transports share the
+  retry/backoff policy in :mod:`fedml_tpu.core.transport.retry`.
 
 Bulk tensor traffic between chips should ride ICI collectives
 (:mod:`fedml_tpu.parallel`), not these transports — they carry control
@@ -17,5 +20,7 @@ MQTT(control)+S3(data) split.
 """
 
 from fedml_tpu.core.transport.base import BaseTransport, Observer
+from fedml_tpu.core.transport.chaos import ChaosTransport, FaultPolicy
 from fedml_tpu.core.transport.loopback import LoopbackHub, LoopbackTransport
+from fedml_tpu.core.transport.retry import RetryPolicy
 from fedml_tpu.core.transport.tcp import TcpTransport

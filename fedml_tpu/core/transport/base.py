@@ -15,7 +15,7 @@ from __future__ import annotations
 import abc
 import queue
 import threading
-from typing import Protocol
+from typing import Callable, Protocol
 
 from fedml_tpu.core.message import Message
 
@@ -32,6 +32,11 @@ class BaseTransport(abc.ABC):
         self._observers: list[Observer] = []
         self._inbox: queue.Queue[Message | None] = queue.Queue()
         self._stopped = threading.Event()
+        # called at DELIVER time (receiver thread), before the message
+        # waits in the inbox — liveness tracking must see arrivals even
+        # while the dispatch thread is busy inside a long handler (a
+        # client mid-local-update would otherwise look dead to itself)
+        self._deliver_hooks: list[Callable[[Message], None]] = []
 
     # -- to implement ------------------------------------------------------
     @abc.abstractmethod
@@ -48,8 +53,13 @@ class BaseTransport(abc.ABC):
     def add_observer(self, obs: Observer) -> None:
         self._observers.append(obs)
 
+    def add_deliver_hook(self, hook: Callable[[Message], None]) -> None:
+        self._deliver_hooks.append(hook)
+
     def deliver(self, msg: Message) -> None:
         """Called by receiver machinery (or peers, for loopback)."""
+        for hook in self._deliver_hooks:
+            hook(msg)
         self._inbox.put(msg)
 
     def handle_receive_message(self, timeout: float | None = None) -> None:

@@ -32,16 +32,30 @@ transport — see :mod:`fedml_tpu.experiments.deploy`).
 from __future__ import annotations
 
 import argparse
+import os
+import queue
 import socket
 import struct
 import threading
-import time
 from typing import Callable
+
+from fedml_tpu.core.transport.retry import (
+    RetryExhausted,
+    RetryPolicy,
+    iter_attempts,
+)
 
 _OP_SUB = b"S"
 _OP_PUB = b"P"
 _TOPIC_HDR = struct.Struct(">I")
 _PAYLOAD_HDR = struct.Struct(">Q")
+
+#: Outbound frames queued per subscriber before the broker declares it
+#: wedged and drops it (MQTT brokers do the same with their inflight
+#: window; QoS-0 semantics make the drop legal).
+_SUB_QUEUE_MAX = 256
+#: Socket-level send timeout per frame to one subscriber.
+_SUB_SEND_TIMEOUT_S = 10.0
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
@@ -86,20 +100,74 @@ def _frame(op: bytes, topic: str, payload: bytes = b"") -> bytes:
     )
 
 
+class _SubWriter:
+    """Per-connection outbound queue + writer thread. Routing threads
+    enqueue and move on; only THIS thread ever blocks on the subscriber's
+    socket, so one wedged consumer cannot stall routing from any
+    publisher (ADVICE round-5: the old per-connection write lock held the
+    publisher's reader thread hostage)."""
+
+    def __init__(self, conn: socket.socket, on_dead):
+        self.conn = conn
+        self._on_dead = on_dead
+        self._q: queue.Queue[bytes | None] = queue.Queue(
+            maxsize=_SUB_QUEUE_MAX
+        )
+        self.thread = threading.Thread(target=self._run, daemon=True)
+        self.thread.start()
+
+    def offer(self, data: bytes) -> bool:
+        """Enqueue without blocking; a full queue means the consumer is
+        wedged — report failure so the router drops it (QoS 0)."""
+        try:
+            self._q.put_nowait(data)
+            return True
+        except queue.Full:
+            return False
+
+    def close(self) -> None:
+        # sentinel, not queue teardown: the writer drains what it can,
+        # then exits; put_nowait keeps close() non-blocking on a full
+        # queue (the writer is stuck anyway — its socket is being closed)
+        try:
+            self._q.put_nowait(None)
+        except queue.Full:
+            pass
+
+    def _run(self) -> None:
+        try:
+            self.conn.settimeout(_SUB_SEND_TIMEOUT_S)
+        except OSError:
+            pass
+        while True:
+            data = self._q.get()
+            if data is None:
+                return
+            try:
+                self.conn.sendall(data)
+            except OSError:  # includes socket.timeout: wedged consumer
+                self._on_dead(self.conn)
+                return
+
+
 class BrokerDaemon:
-    """Topic router. One reader thread per connection; writes to each
-    subscriber are serialized by a per-connection lock (a slow subscriber
-    never interleaves another's frame)."""
+    """Topic router. One reader thread per connection; outbound frames go
+    through per-subscriber send queues (:class:`_SubWriter`), so a slow or
+    stuck subscriber is dropped instead of stalling the router."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self._srv = socket.create_server((host, port))
         self._srv.settimeout(0.5)
         self.host, self.port = self._srv.getsockname()[:2]
         self._subs: dict[str, list[socket.socket]] = {}
-        self._wlocks: dict[socket.socket, threading.Lock] = {}
+        self._writers: dict[socket.socket, _SubWriter] = {}
         self._lock = threading.Lock()
         self._stopped = threading.Event()
         self._accept_thread: threading.Thread | None = None
+        # keyed by connection and pruned in _drop, so a long-lived
+        # broker serving reconnecting clients doesn't accumulate one
+        # dead Thread object per historical connection
+        self._readers: dict[socket.socket, threading.Thread] = {}
 
     def start(self) -> "BrokerDaemon":
         t = threading.Thread(target=self._accept_loop, daemon=True)
@@ -119,11 +187,13 @@ class BrokerDaemon:
                 continue
             except OSError:
                 return
-            with self._lock:
-                self._wlocks[conn] = threading.Lock()
-            threading.Thread(
+            t = threading.Thread(
                 target=self._client_loop, args=(conn,), daemon=True
-            ).start()
+            )
+            with self._lock:
+                self._writers[conn] = _SubWriter(conn, self._drop)
+                self._readers[conn] = t
+            t.start()
 
     def _client_loop(self, conn: socket.socket) -> None:
         try:
@@ -134,7 +204,13 @@ class BrokerDaemon:
                 op, topic, payload = frame
                 if op == _OP_SUB:
                     with self._lock:
-                        self._subs.setdefault(topic, []).append(conn)
+                        subs = self._subs.setdefault(topic, [])
+                        # dedupe: a client that reconnects replays its
+                        # subscriptions AND may retry the triggering SUB
+                        # frame; a doubled entry would deliver every
+                        # publish twice for the rest of the run
+                        if conn not in subs:
+                            subs.append(conn)
                 elif op == _OP_PUB:
                     self._route(topic, payload)
         finally:
@@ -146,21 +222,23 @@ class BrokerDaemon:
         data = _frame(_OP_PUB, topic, payload)
         for s in subs:
             with self._lock:
-                wlock = self._wlocks.get(s)
-            if wlock is None:
+                writer = self._writers.get(s)
+            if writer is None:
                 continue
-            try:
-                with wlock:
-                    s.sendall(data)
-            except OSError:
+            if not writer.offer(data):
+                # queue full: the consumer stopped draining long ago —
+                # cut it loose so the rest of the world keeps routing
                 self._drop(s)
 
     def _drop(self, conn: socket.socket) -> None:
         with self._lock:
-            self._wlocks.pop(conn, None)
+            writer = self._writers.pop(conn, None)
+            self._readers.pop(conn, None)
             for subs in self._subs.values():
                 while conn in subs:
                     subs.remove(conn)
+        if writer is not None:
+            writer.close()
         try:
             conn.close()
         except OSError:
@@ -169,39 +247,113 @@ class BrokerDaemon:
     def stop(self) -> None:
         self._stopped.set()
         self._srv.close()
+        # close every live connection: reader threads blocked in recv()
+        # unblock instead of lingering into interpreter shutdown (daemon
+        # threads inside recv at finalization are a segfault factory)
+        with self._lock:
+            conns = list(self._writers)
+            readers = list(self._readers.values())
+        for conn in conns:
+            self._drop(conn)
+        for t in readers:
+            if t is not threading.current_thread():
+                t.join(timeout=2.0)
 
 
 class RemoteTopicBus:
     """Client side of the broker: the ``TopicBus`` contract over one TCP
     connection. Callbacks run on the bus's reader thread (paho's
-    ``loop_start`` network thread calling ``on_message``)."""
+    ``loop_start`` network thread calling ``on_message``).
+
+    Connect uses the shared exponential-backoff policy (the broker may
+    still be starting); a send that hits a dead socket transparently
+    re-dials and replays the topic subscriptions — paho's
+    ``reconnect_on_failure`` behavior, which the reference's MQTT path
+    gets for free from the library."""
 
     def __init__(
         self, host: str, port: int, connect_timeout: float = 10.0
     ):
-        retry = threading.Event()
-        self._sock = None
-        t_end = time.monotonic() + connect_timeout
-        last_err: Exception | None = None
-        while time.monotonic() < t_end:
-            try:
-                self._sock = socket.create_connection((host, port),
-                                                      timeout=5)
-                break
-            except OSError as err:  # broker may still be starting
-                last_err = err
-                retry.wait(0.2)
-        if self._sock is None:
-            raise ConnectionError(
-                f"broker {host}:{port} unreachable: {last_err}"
-            )
-        self._sock.settimeout(None)
+        self.host, self.port = host, port
+        self._connect_policy = RetryPolicy(
+            max_attempts=1000, base_delay_s=0.1, max_delay_s=1.0,
+            deadline_s=connect_timeout,
+        )
         self._cbs: dict[str, list[Callable[[str, bytes], None]]] = {}
         self._lock = threading.Lock()
         self._wlock = threading.Lock()
         self._stopped = threading.Event()
-        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._reader: threading.Thread | None = None
+        self._sock: socket.socket | None = None
+        with self._wlock:
+            self._dial_locked()
+
+    def _dial_locked(self) -> None:
+        """(Re)connect + replay subscriptions + restart the reader.
+        Caller holds ``_wlock``."""
+        last_err: Exception | None = None
+        # per-process jitter seed: after a broker restart, N clients
+        # must not retry in lockstep waves against the recovering daemon
+        for _ in iter_attempts(self._connect_policy, seed=os.getpid(),
+                               stop=self._stopped):
+            try:
+                self._sock = socket.create_connection(
+                    (self.host, self.port), timeout=5
+                )
+                break
+            except OSError as err:  # broker may still be starting
+                last_err = err
+        else:
+            raise RetryExhausted(
+                f"broker {self.host}:{self.port} unreachable: {last_err}"
+            ) from last_err
+        self._sock.settimeout(None)
+        with self._lock:
+            topics = list(self._cbs)
+        for topic in topics:  # replay subscriptions on the new conn
+            self._sock.sendall(_frame(_OP_SUB, topic))
+        # the previous reader (if any) exits on its dead socket
+        self._reader = threading.Thread(
+            target=self._read_loop, args=(self._sock,), daemon=True
+        )
         self._reader.start()
+
+    def _send_frame(self, data: bytes) -> None:
+        with self._wlock:
+            last: Exception | None = None
+            for attempt in range(3):
+                if attempt:
+                    # redial can itself die mid-handshake (broker
+                    # flapping): the SUB replay inside _dial_locked and
+                    # the resend below stay inside this loop so no bare
+                    # OSError escapes to publish()/subscribe() callers
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                    try:
+                        self._dial_locked()
+                    except RetryExhausted:
+                        raise  # broker unreachable: fail loudly now
+                    except OSError as err:
+                        last = err  # flapped mid-handshake: try again
+                        continue
+                    if data[:1] == _OP_SUB:
+                        # the redial already replayed every subscription
+                        # (including the one this frame carries) —
+                        # resending would double-subscribe
+                        return
+                try:
+                    self._sock.sendall(data)
+                    return
+                except OSError as err:
+                    if self._stopped.is_set():
+                        raise
+                    last = err
+            raise RetryExhausted(
+                f"publish to broker {self.host}:{self.port} failed "
+                f"after reconnects: {last!r}"
+            ) from last
 
     def subscribe(self, topic: str, callback: Callable[[str, bytes], None]):
         first = False
@@ -210,16 +362,14 @@ class RemoteTopicBus:
             first = not cbs
             cbs.append(callback)
         if first:  # one broker-side subscription per topic per process
-            with self._wlock:
-                self._sock.sendall(_frame(_OP_SUB, topic))
+            self._send_frame(_frame(_OP_SUB, topic))
 
     def publish(self, topic: str, payload: bytes) -> None:
-        with self._wlock:
-            self._sock.sendall(_frame(_OP_PUB, topic, payload))
+        self._send_frame(_frame(_OP_PUB, topic, payload))
 
-    def _read_loop(self) -> None:
+    def _read_loop(self, sock: socket.socket) -> None:
         while not self._stopped.is_set():
-            frame = _read_frame(self._sock)
+            frame = _read_frame(sock)
             if frame is None:
                 return
             _, topic, payload = frame
@@ -234,6 +384,9 @@ class RemoteTopicBus:
             self._sock.close()
         except OSError:
             pass
+        if (self._reader is not None
+                and self._reader is not threading.current_thread()):
+            self._reader.join(timeout=2.0)
 
 
 def main(argv=None) -> int:

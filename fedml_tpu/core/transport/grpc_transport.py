@@ -10,25 +10,37 @@ payload is the shared binary codec, and the same 1 GB cap is applied.
 
 from __future__ import annotations
 
+import threading
 from concurrent import futures
 
 from fedml_tpu.core.message import Message
 from fedml_tpu.core.transport.base import BaseTransport
+from fedml_tpu.core.transport.retry import RetryPolicy, call_with_retry
 
 _SERVICE = "fedml_tpu.Comm"
 _METHOD = "SendMessage"
 MAX_MESSAGE_BYTES = 1 << 30  # reference grpc_comm_manager.py:36-40
+#: Floor throughput assumed when bounding an RPC: a bulk model sync gets
+#: the deadline it legitimately needs (mirrors tcp._MIN_SEND_BPS).
+_MIN_SEND_BPS = 1 << 20  # 1 MiB/s
 
 
 class GrpcTransport(BaseTransport):
-    def __init__(self, rank: int, ip_config: dict[int, tuple[str, int]]):
+    def __init__(
+        self,
+        rank: int,
+        ip_config: dict[int, tuple[str, int]],
+        retry: RetryPolicy | None = None,
+    ):
         super().__init__(rank)
         import grpc  # lazy: keep core importable without grpcio
 
         self._grpc = grpc
         self.ip_config = ip_config
+        self.retry = retry if retry is not None else RetryPolicy()
         self._server = None
         self._channels: dict[int, object] = {}
+        self._chan_lock = threading.Lock()
 
     def start(self) -> None:
         if self._server is not None:
@@ -63,19 +75,49 @@ class GrpcTransport(BaseTransport):
 
     def _stub(self, rank: int):
         grpc = self._grpc
-        ch = self._channels.get(rank)
-        if ch is None:
-            host, port = self.ip_config[rank]
-            opts = [
-                ("grpc.max_send_message_length", MAX_MESSAGE_BYTES),
-                ("grpc.max_receive_message_length", MAX_MESSAGE_BYTES),
-            ]
-            ch = grpc.insecure_channel(f"{host}:{port}", options=opts)
-            self._channels[rank] = ch
+        with self._chan_lock:
+            ch = self._channels.get(rank)
+            if ch is None:
+                host, port = self.ip_config[rank]
+                opts = [
+                    ("grpc.max_send_message_length", MAX_MESSAGE_BYTES),
+                    ("grpc.max_receive_message_length", MAX_MESSAGE_BYTES),
+                ]
+                ch = grpc.insecure_channel(f"{host}:{port}", options=opts)
+                self._channels[rank] = ch
         return ch.unary_unary(f"/{_SERVICE}/{_METHOD}")
 
+    def _evict_channel(self, rank: int) -> None:
+        with self._chan_lock:
+            ch = self._channels.pop(rank, None)
+        if ch is not None:
+            ch.close()
+
     def send_message(self, msg: Message) -> None:
-        self._stub(msg.receiver)(msg.encode())
+        """Unary send with backoff retries (reference
+        ``grpc_comm_manager.py`` raises on first failure; real cross-silo
+        peers restart). Each RPC carries a per-attempt deadline so a hung
+        server surfaces as DEADLINE_EXCEEDED, and the channel is rebuilt
+        between attempts (a broken subchannel otherwise stays in
+        TRANSIENT_FAILURE for its own internal backoff window)."""
+        data = msg.encode()
+        rank = msg.receiver
+        # per-RPC deadline: a FRACTION of the overall budget so a hung
+        # (not refusing) server leaves room for the rebuilt-channel
+        # retries — but scaled up for bulk frames, which legitimately
+        # need transfer time proportional to their size
+        per_attempt = max(
+            2.0, self.retry.deadline_s / 3, len(data) / _MIN_SEND_BPS
+        )
+        call_with_retry(
+            lambda: self._stub(rank)(data, timeout=per_attempt),
+            policy=self.retry,
+            retry_on=(self._grpc.RpcError,),
+            describe=f"grpc send rank {self.rank} -> {rank}",
+            seed=self.rank * 1000 + rank,
+            stop=self._stopped,
+            cleanup=lambda: self._evict_channel(rank),
+        )
 
     def stop(self) -> None:
         super().stop()
@@ -86,6 +128,8 @@ class GrpcTransport(BaseTransport):
             # its channel under it raises _InactiveRpcError("Channel
             # closed!") on that thread.
             self._server.stop(grace=2.0).wait(timeout=5)
-        for ch in self._channels.values():
+        with self._chan_lock:
+            channels = list(self._channels.values())
+            self._channels.clear()
+        for ch in channels:
             ch.close()
-        self._channels.clear()

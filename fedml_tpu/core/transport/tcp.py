@@ -15,8 +15,19 @@ import threading
 
 from fedml_tpu.core.message import Message
 from fedml_tpu.core.transport.base import BaseTransport
+from fedml_tpu.core.transport.retry import RetryPolicy, call_with_retry
 
 _HDR = struct.Struct(">Q")
+
+#: Per-attempt socket timeout for connect. A wedged peer (bound port,
+#: dead process) turns into a retryable ``socket.timeout`` instead of an
+#: unbounded stall.
+_SOCKET_TIMEOUT_S = 10.0
+#: Floor throughput assumed when bounding a send: the per-attempt send
+#: timeout is ``max(_SOCKET_TIMEOUT_S, frame_bytes / _MIN_SEND_BPS)`` —
+#: a multi-GB model sync over a slow cross-silo link gets the time it
+#: legitimately needs, while a truly wedged peer still times out.
+_MIN_SEND_BPS = 1 << 20  # 1 MiB/s
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
@@ -30,11 +41,17 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
 
 
 class TcpTransport(BaseTransport):
-    def __init__(self, rank: int, ip_config: dict[int, tuple[str, int]]):
+    def __init__(
+        self,
+        rank: int,
+        ip_config: dict[int, tuple[str, int]],
+        retry: RetryPolicy | None = None,
+    ):
         """``ip_config``: rank -> (host, port) for every participant
         (reference ``ip_config_utils.py`` CSV tables)."""
         super().__init__(rank)
         self.ip_config = ip_config
+        self.retry = retry if retry is not None else RetryPolicy()
         self._server: socket.socket | None = None
         self._conns: dict[int, socket.socket] = {}
         # one lock per peer rank so a slow/blocked connect or send to one
@@ -95,34 +112,52 @@ class TcpTransport(BaseTransport):
         data = msg.encode()
         self._send_wire(msg.receiver, _HDR.pack(len(data)) + data)
 
-    def _send_wire(self, rank: int, frame: bytes) -> None:
-        """Ship pre-framed bytes to ``rank`` over the pooled connection
-        (one dead-socket retry). Subclasses with their own wire format
-        (tensor_rpc) reuse this for the connection machinery."""
-        with self._rank_lock(rank):
-            with self._lock:
-                sock = self._conns.get(rank)
-            if sock is None:
-                host, port = self.ip_config[rank]
-                sock = socket.create_connection((host, port), timeout=30)
-                with self._lock:
-                    self._conns[rank] = sock
+    def _evict(self, rank: int) -> None:
+        with self._lock:
+            sock = self._conns.pop(rank, None)
+        if sock is not None:
             try:
-                sock.sendall(frame)
+                sock.close()
             except OSError:
-                # evict the dead socket and retry once on a fresh connection
-                # (peer restarted / broken pipe)
-                with self._lock:
-                    self._conns.pop(rank, None)
-                try:
-                    sock.close()
-                except OSError:
-                    pass
-                host, port = self.ip_config[rank]
-                sock = socket.create_connection((host, port), timeout=30)
-                with self._lock:
-                    self._conns[rank] = sock
-                sock.sendall(frame)
+                pass
+
+    def _send_once(self, rank: int, frame: bytes) -> None:
+        """One attempt: reuse (or open) the pooled connection, ship the
+        frame. Raises OSError/socket.timeout on a dead or wedged peer.
+        The send timeout bounds the WHOLE ``sendall`` (python >= 3.5
+        semantics), so it scales with the frame size — a legitimate
+        slow bulk transfer must not be indistinguishable from a stall."""
+        with self._lock:
+            sock = self._conns.get(rank)
+        if sock is None:
+            host, port = self.ip_config[rank]
+            sock = socket.create_connection(
+                (host, port), timeout=_SOCKET_TIMEOUT_S
+            )
+            with self._lock:
+                self._conns[rank] = sock
+        sock.settimeout(
+            max(_SOCKET_TIMEOUT_S, len(frame) / _MIN_SEND_BPS)
+        )
+        sock.sendall(frame)
+
+    def _send_wire(self, rank: int, frame: bytes) -> None:
+        """Ship pre-framed bytes to ``rank`` over the pooled connection,
+        with exponential-backoff retries and a per-op deadline (peer
+        restarted / broken pipe / not yet bound). Subclasses with their
+        own wire format (tensor_rpc) reuse this for the connection
+        machinery. A half-sent frame poisons the stream, so every retry
+        starts on a FRESH connection (``_evict`` between attempts)."""
+        with self._rank_lock(rank):
+            call_with_retry(
+                lambda: self._send_once(rank, frame),
+                policy=self.retry,
+                retry_on=(OSError,),
+                describe=f"tcp send rank {self.rank} -> {rank}",
+                seed=self.rank * 1000 + rank,
+                stop=self._stopped,
+                cleanup=lambda: self._evict(rank),
+            )
 
     def stop(self) -> None:
         super().stop()

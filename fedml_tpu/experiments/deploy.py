@@ -22,12 +22,26 @@ sockets matches the compiled simulator to float round-off
 
 Readiness: socket transports have no MPI-style barrier, and the pub/sub
 path drops publishes with no subscriber (MQTT QoS-0 semantics). Clients
-therefore re-announce ``MSG_TYPE_C2S_READY`` every 0.5 s until the first
-inbound server message arrives; the server starts round 0 once all
-``world_size - 1`` distinct ranks have announced. Send failures during
-announcement (server socket not yet bound) are retried, which makes
-process launch order irrelevant — the reference gets the same property
-from MQTT broker buffering + its client "register" message.
+therefore re-announce ``MSG_TYPE_C2S_READY`` every 0.5 s until the
+server ACKs (``MSG_TYPE_S2C_ACK`` reply to each READY) or any other
+server message arrives; the server starts round 0 once all
+``world_size - 1`` distinct ranks have announced. The ACK matters:
+liveness must not be inferred from WORK traffic — a later-rank SplitNN
+client legitimately idles for the whole of its predecessors' epochs, and
+before the ACK existed it would hit ``ready_timeout`` and kill a healthy
+run. Send failures during announcement (server socket not yet bound) are
+retried, which makes process launch order irrelevant — the reference
+gets the same property from MQTT broker buffering + its client
+"register" message.
+
+Liveness (docs/FAULT_TOLERANCE.md): once the run is underway both sides
+heartbeat (``MSG_TYPE_HEARTBEAT``) and watch per-peer last-seen times.
+The server routes dead peers into the actor's straggler logic
+(``FedAvgServerActor.on_peer_dead`` — quorum/deadline rounds) instead of
+blocking forever on its inbox; clients detect a dead server and exit
+loudly. Deterministic fault injection for all of this lives in
+:mod:`fedml_tpu.core.transport.chaos` and is threaded here via
+``DeployConfig.fault``.
 """
 
 from __future__ import annotations
@@ -45,8 +59,14 @@ import numpy as np
 
 from fedml_tpu.config import ExperimentConfig
 from fedml_tpu.core.manager import Manager, ServerManager, create_transport
-from fedml_tpu.core.message import MSG_TYPE_C2S_READY, Message
+from fedml_tpu.core.message import (
+    MSG_TYPE_C2S_READY,
+    MSG_TYPE_HEARTBEAT,
+    MSG_TYPE_S2C_ACK,
+    Message,
+)
 from fedml_tpu.core.transport.base import BaseTransport
+from fedml_tpu.core.transport.chaos import ChaosTransport, FaultPolicy
 
 FEDAVG_FAMILY = ("fedavg", "fedopt", "fednova")
 DEPLOY_ALGORITHMS = FEDAVG_FAMILY + ("splitnn",)
@@ -66,6 +86,17 @@ class DeployConfig:
     broker: tuple[str, int] | None = None  # pubsub* backends
     blob_dir: str | None = None  # pubsub_blob file-backed store
     ready_timeout: float = 120.0
+    # -- fault tolerance (docs/FAULT_TOLERANCE.md) -------------------------
+    heartbeats: bool = True  # arm the liveness protocol once underway
+    heartbeat_interval_s: float = 2.0
+    heartbeat_timeout_s: float = 30.0
+    # straggler-tolerant rounds (fedavg family): fraction of live workers
+    # whose results close a round at the deadline; None deadline = wait
+    # for every live worker (dead ones are still skipped via heartbeats)
+    quorum_fraction: float = 1.0
+    round_deadline_s: float | None = None
+    # seeded fault injection for THIS rank (None/disabled = real traffic)
+    fault: FaultPolicy | None = None
 
 
 def load_ip_config(path: str) -> dict[int, tuple[str, int]]:
@@ -91,56 +122,132 @@ def _make_transport(dep: DeployConfig) -> BaseTransport:
                 "blob store)"
             )
             store = BlobStore(root=dep.blob_dir)
-        return create_transport(
+        transport = create_transport(
             dep.backend, dep.rank, bus=bus, store=store,
             size=dep.world_size,
         )
-    assert dep.ip_config is not None, f"{dep.backend} needs --ip_config"
-    return create_transport(dep.backend, dep.rank, ip_config=dep.ip_config)
+    else:
+        assert dep.ip_config is not None, f"{dep.backend} needs --ip_config"
+        transport = create_transport(
+            dep.backend, dep.rank, ip_config=dep.ip_config
+        )
+    if dep.fault is not None and dep.fault.enabled():
+        transport = ChaosTransport(transport, dep.fault)
+    return transport
 
 
 # ---------------------------------------------------------------------------
-# readiness handshake
+# readiness handshake + liveness
 # ---------------------------------------------------------------------------
+
+
+def _server_dead_peer_cb(server: ServerManager):
+    """Route heartbeat-detected client deaths into the actor.
+
+    Actors with straggler-tolerant rounds (``on_peer_dead``) absorb the
+    death — the round closes over the survivors or aborts with a quorum
+    diagnostic. Actors without it (SplitNN's strictly-sequential
+    round-robin cannot skip a rank) record the failure and stop the
+    transport, which is exactly the "fail loudly instead of hanging"
+    contract from ADVICE round-5 (``deploy.py:125``)."""
+
+    def on_dead(rank: int) -> None:
+        handler = getattr(server, "on_peer_dead", None)
+        if handler is not None:
+            handler(rank)
+            return
+        server._liveness_failure = (
+            f"client rank {rank} became unreachable mid-run "
+            "(heartbeats stopped)"
+        )
+        server.transport.stop()
+
+    return on_dead
 
 
 def _serve_with_ready_barrier(
     server: ServerManager, dep: DeployConfig, kickoff
 ) -> None:
-    """Start round 0 once all clients have announced; then drain until the
-    actor finishes the run."""
+    """ACK every READY, start round 0 once all clients have announced,
+    arm the dead-client watchdog, then drain until the actor finishes."""
     ready: set[int] = set()
     started = threading.Event()
 
     def on_ready(msg: Message) -> None:
+        # ACK unconditionally (duplicates arrive by design — clients
+        # re-announce until acknowledged): the ACK tells a client the
+        # control channel works BOTH ways, independent of when its
+        # first work message will come (a later-rank SplitNN client may
+        # idle for the whole of its predecessors' epochs)
+        try:
+            server.send_message(
+                Message(MSG_TYPE_S2C_ACK, 0, msg.sender, {})
+            )
+        except Exception:
+            pass  # client endpoint flapped; it will re-announce
         ready.add(msg.sender)
-        # duplicates arrive by design (clients re-announce until the
-        # first sync lands); kick off exactly once
         if len(ready) >= dep.world_size - 1 and not started.is_set():
             started.set()
+            if dep.heartbeats:
+                server.enable_liveness(
+                    range(1, dep.world_size),
+                    interval_s=dep.heartbeat_interval_s,
+                    timeout_s=dep.heartbeat_timeout_s,
+                    on_dead=_server_dead_peer_cb(server),
+                )
             kickoff()
 
+    def on_beat(msg: Message) -> None:
+        # echo: a client's liveness view must be satisfiable BEFORE the
+        # barrier completes (its watchdog arms at ACK time, but the
+        # server's own beats only start at kickoff — without the echo, a
+        # client ready early would see "silence" while the slowest rank
+        # is still importing jax, declare the server dead, and cascade
+        # the whole launch into failure)
+        try:
+            server.send_message(
+                Message(MSG_TYPE_HEARTBEAT, 0, msg.sender, {})
+            )
+        except Exception:
+            pass
+
     server.register_message_receive_handler(MSG_TYPE_C2S_READY, on_ready)
+    server.register_message_receive_handler(MSG_TYPE_HEARTBEAT, on_beat)
     server.transport.start()
     server.run()  # blocks until the actor's finish path stops the transport
 
 
 def _announce_until_first_message(
     mgr: Manager, dep: DeployConfig
-) -> threading.Event:
-    """Client side: re-send READY until any server message arrives.
+) -> tuple[threading.Event, list[str]]:
+    """Client side: re-send READY until the server's ACK (or any other
+    server message) arrives, then arm the server-liveness watchdog.
 
-    Returns the first-inbound event; if ``ready_timeout`` expires first,
-    the loop STOPS the transport so the caller's ``run()`` unblocks — the
-    caller must then check the event and fail loudly (a silently-hung
-    client would wedge the whole launcher run)."""
+    Returns ``(first-inbound event, failure log)``. If ``ready_timeout``
+    expires before any server message, the loop STOPS the transport so
+    the caller's ``run()`` unblocks — the caller must then check the
+    event and fail loudly (a silently-hung client would wedge the whole
+    launcher run). Once the server HAS been heard from, the heartbeat
+    monitor takes over: a server that goes silent mid-run (crashed
+    endpoint, dead broker) stops the transport and records the failure
+    for the caller to raise. Pub/sub caveat: a publish to a dead peer
+    succeeds silently (MQTT QoS-0), so there the staleness detector is
+    the only signal — which is why BOTH sides beat."""
     got = threading.Event()
+    failures: list[str] = []
 
     class _FirstInbound:
         def receive_message(self, msg_type: int, msg: Message) -> None:
             got.set()
 
     mgr.transport.add_observer(_FirstInbound())
+
+    def on_server_dead(rank: int) -> None:
+        failures.append(
+            "server became unreachable mid-run (no inbound traffic for "
+            f"{dep.heartbeat_timeout_s}s)"
+        )
+        mgr.transport.stop()
 
     def loop() -> None:
         deadline = time.monotonic() + dep.ready_timeout
@@ -154,9 +261,17 @@ def _announce_until_first_message(
             got.wait(0.5)
         if not got.is_set():
             mgr.transport.stop()  # unblock run() -> caller raises
+            return
+        if dep.heartbeats:
+            mgr.enable_liveness(
+                [0],
+                interval_s=dep.heartbeat_interval_s,
+                timeout_s=dep.heartbeat_timeout_s,
+                on_dead=on_server_dead,
+            )
 
     threading.Thread(target=loop, daemon=True).start()
-    return got
+    return got, failures
 
 
 def _check_contacted(got: threading.Event, dep: DeployConfig) -> None:
@@ -168,37 +283,15 @@ def _check_contacted(got: threading.Event, dep: DeployConfig) -> None:
         )
 
 
-def _run_client_with_liveness(
-    mgr: Manager,
-    dep: DeployConfig,
-    got: threading.Event,
-    idle_probe_s: float = 15.0,
-) -> None:
-    """Drain the client's inbox until FINISH, probing server liveness on
-    idle windows: a server that dies MID-run sends nothing, and a plain
-    ``run()`` would block on the inbox forever. On each idle window we
-    re-send READY (the server's ready-barrier handler tolerates
-    duplicates); a dead server endpoint makes the send raise on socket
-    backends, which we convert to a loud failure. BEFORE the first
-    server contact (``got`` unset) probe failures are expected — the
-    server may simply not have bound yet — so liveness enforcement only
-    arms once contact is established; until then launch-order tolerance
-    belongs to :func:`_announce_until_first_message`'s ready_timeout.
-    Pub/sub limitation: with the broker alive a publish to a dead
-    server succeeds silently (MQTT QoS-0), so only broker death is
-    detectable there."""
+def _run_client(mgr: Manager, dep: DeployConfig) -> None:
+    """Client main loop: announce, drain until FINISH (or a detected
+    server death / readiness timeout), fail loudly on either."""
     mgr.transport.start()
-    while not mgr.transport._stopped.is_set():
-        mgr.transport.handle_receive_message(timeout=idle_probe_s)
-        if mgr.transport._stopped.is_set() or not got.is_set():
-            continue  # stopped -> loop exits; pre-contact -> no probe
-        try:  # idle window: is the server endpoint still there?
-            mgr.send_message(Message(MSG_TYPE_C2S_READY, mgr.rank, 0, {}))
-        except Exception as err:
-            mgr.transport.stop()
-            raise RuntimeError(
-                f"server became unreachable mid-run: {err!r}"
-            ) from err
+    got, failures = _announce_until_first_message(mgr, dep)
+    mgr.run()
+    _check_contacted(got, dep)
+    if failures:
+        raise RuntimeError(failures[0])
 
 
 # ---------------------------------------------------------------------------
@@ -245,11 +338,25 @@ def _run_fedavg_rank(cfg: ExperimentConfig, dep: DeployConfig) -> dict:
     transport = _make_transport(dep)
 
     if dep.role == "server":
+        from fedml_tpu.algorithms.distributed_fedavg import (
+            QuorumLostError,
+            RoundPolicy,
+        )
+
         server = FedAvgServerActor(
             dep.world_size, transport, model, cfg,
             num_clients=cfg.data.num_clients, data=data,
+            round_policy=RoundPolicy(
+                quorum_fraction=dep.quorum_fraction,
+                round_deadline_s=dep.round_deadline_s,
+            ),
         )
         _serve_with_ready_barrier(server, dep, server.start_round)
+        if server.failure is not None:
+            raise QuorumLostError(
+                f"run aborted (straggler tolerance exhausted): "
+                f"{server.failure}"
+            )
         if not server.done.is_set():
             raise RuntimeError(
                 f"server stopped before completing {cfg.fed.num_rounds} "
@@ -275,16 +382,14 @@ def _run_fedavg_rank(cfg: ExperimentConfig, dep: DeployConfig) -> dict:
             "rounds": server.round_idx,
             "final_params": path,
             "params_digest": _params_digest(server.variables),
+            "dead_peers": sorted(server.dead_peers),
             **metrics,
         }
 
     client = FedAvgClientActor(
         dep.rank, dep.world_size, transport, model, data, cfg
     )
-    client.transport.start()
-    got = _announce_until_first_message(client, dep)
-    _run_client_with_liveness(client, dep, got)
-    _check_contacted(got, dep)
+    _run_client(client, dep)
     return {"role": "client", "rank": dep.rank, "status": "finished"}
 
 
@@ -320,10 +425,13 @@ def _run_splitnn_rank(cfg: ExperimentConfig, dep: DeployConfig) -> dict:
         )
         _serve_with_ready_barrier(server, dep, server.start_round)
         if not server.done.is_set():
+            liveness = getattr(server, "_liveness_failure", None)
             raise RuntimeError(
-                f"splitnn server stopped before completing "
-                f"{cfg.fed.num_rounds} rounds (round_idx="
-                f"{server.round_idx})"
+                liveness
+                if liveness is not None
+                else f"splitnn server stopped before completing "
+                     f"{cfg.fed.num_rounds} rounds (round_idx="
+                     f"{server.round_idx})"
             )
         path = _write_final(cfg, "final_server_params", server.server_vars)
         return {
@@ -342,10 +450,7 @@ def _run_splitnn_rank(cfg: ExperimentConfig, dep: DeployConfig) -> dict:
         jax.tree.map(lambda s: s[dep.rank - 1], state0.client_stack),
         data, cfg,
     )
-    client.transport.start()
-    got = _announce_until_first_message(client, dep)
-    _run_client_with_liveness(client, dep, got)
-    _check_contacted(got, dep)
+    _run_client(client, dep)
     path = _write_final(
         cfg, f"final_client{dep.rank}_params", client.c_vars
     )

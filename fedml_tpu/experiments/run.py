@@ -98,6 +98,43 @@ def parse_args(argv=None) -> tuple[ExperimentConfig, argparse.Namespace]:
     p.add_argument("--ready_timeout", type=float, default=120.0,
                    help="seconds a client re-announces readiness before "
                         "giving up")
+    # -- fault tolerance (docs/FAULT_TOLERANCE.md) -------------------------
+    p.add_argument("--no_heartbeats", action="store_true",
+                   help="disable the liveness protocol (heartbeats + "
+                        "dead-peer detection)")
+    p.add_argument("--heartbeat_interval", type=float, default=2.0,
+                   help="seconds between liveness beacons")
+    p.add_argument("--heartbeat_timeout", type=float, default=30.0,
+                   help="seconds of peer silence before it is declared "
+                        "dead")
+    p.add_argument("--quorum_fraction", type=float, default=1.0,
+                   help="fraction of live workers whose results close a "
+                        "round once --round_deadline expires (server "
+                        "rank; fedavg family)")
+    p.add_argument("--round_deadline", type=float, default=None,
+                   help="per-round wall-clock budget in seconds: at "
+                        "expiry the round closes with >= quorum results "
+                        "or the run aborts (0/unset = no deadline)")
+    # -- seeded fault injection for THIS rank (chaos testing) --------------
+    p.add_argument("--fault_seed", type=int, default=0,
+                   help="seed for the deterministic fault stream")
+    p.add_argument("--fault_drop", type=float, default=0.0,
+                   help="per-message send drop probability")
+    p.add_argument("--fault_delay", type=float, default=0.0,
+                   help="per-message send delay probability")
+    p.add_argument("--fault_delay_max", type=float, default=0.05,
+                   help="max injected delay in seconds")
+    p.add_argument("--fault_dup", type=float, default=0.0,
+                   help="per-message duplication probability")
+    p.add_argument("--fault_reorder", type=float, default=0.0,
+                   help="per-message reorder probability")
+    p.add_argument("--fault_crash_round", type=int, default=None,
+                   help="crash this rank on the first message tagged "
+                        "with round_idx >= N")
+    p.add_argument("--fault_crash_mode", type=str, default="silent",
+                   choices=["silent", "exit"],
+                   help="silent: the rank stops communicating; exit: "
+                        "the process dies (os._exit) like kill -9")
     a = p.parse_args(argv)
 
     if a.config:
@@ -154,6 +191,40 @@ def parse_args(argv=None) -> tuple[ExperimentConfig, argparse.Namespace]:
     return cfg, a
 
 
+def _parse_broker(value: str) -> tuple[str, int]:
+    """``host:port`` -> tuple, with a clear SystemExit on malformed input
+    (a bare ``--broker localhost`` used to crash with a ValueError
+    traceback from ``int('localhost')``)."""
+    host, sep, port = value.rpartition(":")
+    if not sep or not host or not port.isdigit():
+        raise SystemExit(
+            f"--broker expects host:port (e.g. 127.0.0.1:29950), "
+            f"got {value!r}"
+        )
+    port_num = int(port)
+    if not (0 < port_num < 65536):
+        raise SystemExit(
+            f"--broker port must be in [1, 65535], got {port_num}"
+        )
+    return host, port_num
+
+
+def _fault_policy(a) -> "FaultPolicy | None":
+    from fedml_tpu.core.transport.chaos import FaultPolicy
+
+    policy = FaultPolicy(
+        seed=a.fault_seed,
+        drop_prob=a.fault_drop,
+        delay_prob=a.fault_delay,
+        delay_max_s=a.fault_delay_max,
+        dup_prob=a.fault_dup,
+        reorder_prob=a.fault_reorder,
+        crash_at_round=a.fault_crash_round,
+        crash_mode=a.fault_crash_mode,
+    )
+    return policy if policy.enabled() else None
+
+
 def _deploy_config(a) -> "DeployConfig":
     from fedml_tpu.experiments.deploy import DeployConfig, load_ip_config
 
@@ -171,10 +242,22 @@ def _deploy_config(a) -> "DeployConfig":
         raise SystemExit("server is always rank 0")
     if a.role == "client" and not (1 <= rank < a.world_size):
         raise SystemExit("client rank must be in [1, world_size)")
-    broker = None
-    if a.broker is not None:
-        host, _, port = a.broker.rpartition(":")
-        broker = (host, int(port))
+    # simulator-only knobs are silently inert under --role — say so
+    # loudly rather than letting the user think they took effect
+    if a.repetitions != 1:
+        print(
+            "warning: --repetitions is a simulator flag and is ignored "
+            "under --role (each deployment process runs exactly one rank)",
+            file=sys.stderr,
+        )
+    if a.checkpoint_every:
+        print(
+            "warning: --checkpoint_every is a simulator flag and is "
+            "ignored under --role (the actor runtime has no round "
+            "checkpointing yet)",
+            file=sys.stderr,
+        )
+    broker = _parse_broker(a.broker) if a.broker is not None else None
     return DeployConfig(
         role=a.role,
         rank=rank,
@@ -184,6 +267,14 @@ def _deploy_config(a) -> "DeployConfig":
         broker=broker,
         blob_dir=a.blob_dir,
         ready_timeout=a.ready_timeout,
+        heartbeats=not a.no_heartbeats,
+        heartbeat_interval_s=a.heartbeat_interval,
+        heartbeat_timeout_s=a.heartbeat_timeout,
+        quorum_fraction=a.quorum_fraction,
+        round_deadline_s=(
+            a.round_deadline if a.round_deadline else None
+        ),
+        fault=_fault_policy(a),
     )
 
 

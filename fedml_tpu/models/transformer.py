@@ -97,10 +97,7 @@ def make_sequence_parallel_lm_step(
 
     from jax.sharding import PartitionSpec as P
 
-    try:
-        from jax import shard_map
-    except ImportError:  # older jax
-        from jax.experimental.shard_map import shard_map
+    from fedml_tpu.core.compat import shard_map
 
     from fedml_tpu.ops.ring_attention import ring_attention
 

@@ -34,8 +34,9 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+from fedml_tpu.core.compat import shard_map
 
 from fedml_tpu.config import ExperimentConfig
 from fedml_tpu.core import random as R

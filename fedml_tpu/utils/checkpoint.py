@@ -184,6 +184,15 @@ def _from_savable(template: Any, blob: Any):
         return type(template)(vals)
     import jax.numpy as jnp
 
-    arr = jnp.asarray(blob)
-    tmpl = jnp.asarray(template)
-    return arr.astype(tmpl.dtype) if arr.dtype != tmpl.dtype else arr
+    # jnp.array (copy=True), NOT asarray: a restored leaf is a numpy
+    # array whose memory orbax owns, and asarray's CPU zero-copy alias
+    # hands that memory to jax — a donating jit (FedAvgSim's round
+    # donates its state) then overwrites/frees a buffer jax never owned,
+    # which was a flaky SIGSEGV on every checkpoint-resume run.
+    # dtype comes from the attribute when present — np.asarray on a live
+    # device-array template would pull the whole leaf to host just to
+    # read it.
+    dtype = getattr(template, "dtype", None)
+    if dtype is None:  # python scalar leaf
+        dtype = np.result_type(template)
+    return jnp.array(blob, dtype=dtype)

@@ -9,10 +9,7 @@ import numpy as np
 import pytest
 
 pytestmark = pytest.mark.slow
-try:
-    from jax import shard_map
-except ImportError:  # older jax
-    from jax.experimental.shard_map import shard_map
+from fedml_tpu.core.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from fedml_tpu.ops.flash_attention import flash_attention

@@ -37,6 +37,10 @@ def _free_ports(n):
 def _subproc_env():
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"  # deterministic vs the in-test sim (CPU)
+    # conftest.py pins threefry_partitionable=True for the in-test sims;
+    # the subprocess ranks must derive the SAME rng stream or the
+    # cross-process equality pins compare different initializations
+    env["JAX_THREEFRY_PARTITIONABLE"] = "1"
     env.setdefault("JAX_COMPILATION_CACHE_DIR",
                    os.environ.get("FEDML_TPU_TEST_CACHE",
                                   "/tmp/fedml_tpu_test_xla_cache"))

@@ -86,9 +86,9 @@ def test_sync_batchnorm_exact_across_shards():
     concatenated batch — forward outputs AND running-stat updates
     (reference SynchronizedBatchNorm parity; our previous sync-BN-lite
     only pmean'd the stats after the fact)."""
-    from jax import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
+    from fedml_tpu.core.compat import shard_map
     from fedml_tpu.models.vision import SyncBatchNorm
 
     mesh = Mesh(np.array(jax.devices()[:4]), ("data",))
