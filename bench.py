@@ -1282,6 +1282,13 @@ def main():
         sys.exit(3)
 
     _enable_compile_cache()
+    # telemetry: every suite stage runs inside a tracer span and each
+    # emitted record carries the cumulative span summary + metrics
+    # snapshot, so future perf PRs get comm/compute breakdowns in the
+    # BENCH_* artifact for free (docs/OBSERVABILITY.md)
+    from fedml_tpu.core import telemetry
+
+    telemetry.configure(rank=0, trace=True)
     t_start = time.perf_counter()
 
     # Every emitted line also lands in runs/bench_latest.jsonl: the
@@ -1299,6 +1306,13 @@ def main():
                              "argv": sys.argv[1:]}) + "\n")
 
     def emit(rec):
+        rec = dict(
+            rec,
+            telemetry={
+                "spans": telemetry.TRACER.summary(),
+                "metrics": telemetry.METRICS.snapshot(),
+            },
+        )
         print(json.dumps(rec), flush=True)
         _jsonl.write(json.dumps(rec) + "\n")
         _jsonl.flush()
@@ -1309,21 +1323,36 @@ def main():
             flush=True,
         )
 
+    def staged(name, fn):
+        """Run one suite stage inside a tracer span (phase breakdowns
+        land in every later record's telemetry.spans)."""
+        with telemetry.TRACER.span(f"bench.{name}"):
+            return fn()
+
     if args.synthetic_acc:
-        rec = synthetic_leaf_acc_record()
+        rec = staged("synthetic_acc", synthetic_leaf_acc_record)
         if rec:
             emit(rec)
         return
     if args.family:
-        emit(family_rate_record(args.family, args.rounds,
-                                args.skip_torch_baseline))
+        emit(staged(
+            f"family.{args.family}",
+            lambda: family_rate_record(args.family, args.rounds,
+                                       args.skip_torch_baseline),
+        ))
         return
     if args.fedgdkd:
-        emit(fedgdkd_record(args.rounds, args.skip_torch_baseline))
+        emit(staged(
+            "fedgdkd",
+            lambda: fedgdkd_record(args.rounds, args.skip_torch_baseline),
+        ))
         return
     if args.fedgdkd_scale:
-        emit(fedgdkd_record(args.rounds, args.skip_torch_baseline,
-                            **FEDGDKD_SCALE_KWARGS))
+        emit(staged(
+            "fedgdkd_scale",
+            lambda: fedgdkd_record(args.rounds, args.skip_torch_baseline,
+                                   **FEDGDKD_SCALE_KWARGS),
+        ))
         return
     if args.target_acc is not None:
         model_name = "resnet56" if args.std else "resnet56_s2d"
@@ -1334,8 +1363,11 @@ def main():
         else:
             sim, _ = build_sim(model_name=model_name)
             label = f"100c_6k_cifar10_{model_name}"
-        emit(time_to_acc_record(sim, label, args.target_acc,
-                                args.max_rounds))
+        emit(staged(
+            f"tta.{label}",
+            lambda: time_to_acc_record(sim, label, args.target_acc,
+                                       args.max_rounds),
+        ))
         return
     if args.northstar or args.s2d or args.std:
         model_name = "resnet56" if args.std else "resnet56_s2d"
@@ -1348,13 +1380,16 @@ def main():
         else:
             sim, _ = build_sim(model_name=model_name)
             metric = f"fedavg_rounds_per_sec_100c_cifar10_{model_name}"
-        emit(rate_record(sim, metric, args.rounds, model_name,
-                         args.skip_torch_baseline))
+        emit(staged(
+            metric,
+            lambda: rate_record(sim, metric, args.rounds, model_name,
+                                args.skip_torch_baseline),
+        ))
         return
 
     # ---- default: the full driver suite, headline LAST ----
     try:
-        rec = synthetic_leaf_acc_record()
+        rec = staged("synthetic_acc", synthetic_leaf_acc_record)
     except Exception as err:  # an accuracy-row failure must never
         rec = None            # abort the rounds/sec suite below
         print(f"[bench] synthetic_acc failed: {err}", file=sys.stderr,
@@ -1363,26 +1398,38 @@ def main():
         emit(rec)
     for fam in FAMILY_SPECS:
         try:
-            emit(family_rate_record(fam, args.rounds,
-                                    args.skip_torch_baseline))
+            emit(staged(
+                f"family.{fam}",
+                lambda fam=fam: family_rate_record(
+                    fam, args.rounds, args.skip_torch_baseline),
+            ))
         except Exception as err:  # one family must not sink the suite
             print(f"[bench] family {fam} failed: {err}", file=sys.stderr,
                   flush=True)
     try:
-        emit(fedgdkd_record(args.rounds, args.skip_torch_baseline))
+        emit(staged(
+            "fedgdkd",
+            lambda: fedgdkd_record(args.rounds, args.skip_torch_baseline),
+        ))
     except Exception as err:
         print(f"[bench] fedgdkd failed: {err}", file=sys.stderr,
               flush=True)
     try:
-        emit(fedgdkd_record(args.rounds, args.skip_torch_baseline,
-                            **FEDGDKD_SCALE_KWARGS))
+        emit(staged(
+            "fedgdkd_scale",
+            lambda: fedgdkd_record(args.rounds, args.skip_torch_baseline,
+                                   **FEDGDKD_SCALE_KWARGS),
+        ))
     except Exception as err:
         print(f"[bench] fedgdkd-scale failed: {err}", file=sys.stderr,
               flush=True)
     sim, _ = build_sim(model_name="resnet56")
-    emit(rate_record(
-        sim, "fedavg_rounds_per_sec_100c_cifar10_resnet56",
-        args.rounds, "resnet56", args.skip_torch_baseline,
+    emit(staged(
+        "rate.resnet56_std",
+        lambda: rate_record(
+            sim, "fedavg_rounds_per_sec_100c_cifar10_resnet56",
+            args.rounds, "resnet56", args.skip_torch_baseline,
+        ),
     ))
     del sim
     ns, _ = build_sim(num_clients=1000, full_cifar=True,
@@ -1390,19 +1437,29 @@ def main():
     # time-to-accuracy AT THE NORTH-STAR SCALE (1000 clients, 50k
     # samples, non-IID alpha=0.5), sharing one sim+executable with the
     # north-star rate line (VERDICT r3 item 5)
-    emit(time_to_acc_record(
-        ns, "1000c_50k_noniid_cifar10_resnet56_s2d", 0.8, 2000,
-        cache=True,
+    emit(staged(
+        "tta.northstar",
+        lambda: time_to_acc_record(
+            ns, "1000c_50k_noniid_cifar10_resnet56_s2d", 0.8, 2000,
+            cache=True,
+        ),
     ))
-    emit(rate_record(
-        ns, "fedavg_rounds_per_sec_1000c_noniid_cifar10_resnet56_s2d",
-        args.rounds, "resnet56_s2d", args.skip_torch_baseline, cache=True,
+    emit(staged(
+        "rate.northstar_s2d",
+        lambda: rate_record(
+            ns, "fedavg_rounds_per_sec_1000c_noniid_cifar10_resnet56_s2d",
+            args.rounds, "resnet56_s2d", args.skip_torch_baseline,
+            cache=True,
+        ),
     ))
     del ns
     s2d_sim, _ = build_sim(model_name="resnet56_s2d")
-    emit(rate_record(
-        s2d_sim, "fedavg_rounds_per_sec_100c_cifar10_resnet56_s2d",
-        args.rounds, "resnet56_s2d", args.skip_torch_baseline,
+    emit(staged(
+        "rate.s2d_headline",
+        lambda: rate_record(
+            s2d_sim, "fedavg_rounds_per_sec_100c_cifar10_resnet56_s2d",
+            args.rounds, "resnet56_s2d", args.skip_torch_baseline,
+        ),
     ))
     del s2d_sim
 

@@ -27,6 +27,69 @@ python -m pytest tests -m "not slow" -q -x -p no:cacheprovider
 # hand-edited; skips cleanly when no artifact exists on a fresh clone)
 python scripts/render_perf_tables.py --check
 
+echo "== telemetry smoke: 2-rank loopback trace -> merge -> validate =="
+# a 1-server + 1-worker loopback world with the telemetry plane on must
+# yield a Perfetto-loadable merged trace whose send/deliver pairs share
+# trace ids across both pids, plus nonzero transport counters
+# (docs/OBSERVABILITY.md)
+JAX_PLATFORMS=cpu python - "$OUT/telemetry" <<'EOF'
+import json, sys, threading
+tdir = sys.argv[1]
+from fedml_tpu.core import telemetry
+telemetry.configure(telemetry_dir=tdir, rank=0)
+from fedml_tpu.algorithms.distributed_fedavg import (
+    FedAvgClientActor, FedAvgServerActor,
+)
+from fedml_tpu.config import (
+    DataConfig, ExperimentConfig, FedConfig, ModelConfig, TrainConfig,
+)
+from fedml_tpu.core.transport.loopback import LoopbackHub
+from fedml_tpu.data.loaders import load_dataset
+from fedml_tpu.models import create_model
+
+cfg = ExperimentConfig(
+    data=DataConfig(dataset="fake_mnist", num_clients=1, batch_size=32,
+                    seed=0),
+    model=ModelConfig(name="lr", num_classes=10, input_shape=(28, 28, 1)),
+    train=TrainConfig(lr=0.1, epochs=1),
+    fed=FedConfig(num_rounds=1, clients_per_round=1, eval_every=1),
+    seed=0,
+)
+data = load_dataset(cfg.data)
+model = create_model(cfg.model)
+hub = LoopbackHub()
+server = FedAvgServerActor(2, hub.create(0), model, cfg, num_clients=1)
+client = FedAvgClientActor(1, 2, hub.create(1), model, data, cfg)
+t = threading.Thread(target=client.run, daemon=True)
+t.start()
+server.start_round()
+server.run()
+assert server.done.is_set(), "loopback round never completed"
+t.join(timeout=10)
+telemetry.flush()
+counters = telemetry.METRICS.snapshot()["counters"]
+assert counters.get("transport.bytes_sent", 0) > 0, counters
+assert counters.get("transport.messages_received", 0) > 0, counters
+EOF
+python scripts/merge_trace.py "$OUT/telemetry" --out "$OUT/telemetry/merged.json" >/dev/null
+python - "$OUT/telemetry/merged.json" <<'EOF'
+import json, sys
+merged = json.load(open(sys.argv[1]))
+evs = merged["traceEvents"]
+assert evs, "merged trace is empty"
+pids = {e["pid"] for e in evs if e.get("ph") != "M"}
+assert {0, 1} <= pids, f"expected both ranks as pids, got {pids}"
+sends = {e["args"]["span_id"]: e for e in evs
+         if e.get("name") == "msg_send"}
+delivers = {e["args"]["span_id"]: e for e in evs
+            if e.get("name") == "msg_deliver"}
+linked = [s for s in sends if s in delivers
+          and sends[s]["pid"] != delivers[s]["pid"]]
+assert linked, "no cross-rank send->deliver pair shares a span id"
+print(f"telemetry smoke ok: {len(evs)} events, "
+      f"{len(linked)} cross-rank message flows")
+EOF
+
 echo "== 2/3 smoke matrix (tiny runs) =="
 # one process for the whole matrix: same CLI argv surface via
 # run.main(argv), but jax/backend startup and compile caches paid once

@@ -16,6 +16,7 @@ from __future__ import annotations
 import dataclasses
 import math
 import threading
+import time
 from typing import Callable
 
 import jax
@@ -23,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from fedml_tpu.config import ExperimentConfig
+from fedml_tpu.core import telemetry
 from fedml_tpu.core import tree as T
 from fedml_tpu.core.manager import ClientManager, ServerManager
 from fedml_tpu.core.message import (
@@ -163,6 +165,7 @@ class FedAvgServerActor(ServerManager):
         self.batch_size = cfg.data.batch_size if batch_size is None else batch_size
         self.root_key = jax.random.key(cfg.seed)
         self.round_idx = 0
+        self._round_t0 = time.monotonic()
         self._results: dict[int, tuple[dict, float]] = {}
         self._lock = threading.Lock()
         self.on_round_done = on_round_done
@@ -209,6 +212,13 @@ class FedAvgServerActor(ServerManager):
 
     def start_round(self) -> None:
         cohort = self._sample()
+        self._round_t0 = time.monotonic()
+        tr = telemetry.TRACER
+        if tr is not None:
+            # one trace id per round: every sync this broadcast ships
+            # (and every result it provokes) correlates under it
+            telemetry.set_current_trace(telemetry.new_trace_id())
+            tr.log_round_start(self.round_idx)
         host_vars = jax.tree.map(np.asarray, self.variables)
         with self._lock:
             ranks = self._live_workers()
@@ -245,6 +255,14 @@ class FedAvgServerActor(ServerManager):
                 return
             self.dead_peers.add(rank)
             self._results.pop(rank, None)  # a dead rank's result is void
+            dead = sorted(self.dead_peers)  # snapshot under the lock
+        telemetry.METRICS.inc("round.dead_peers")
+        # a dead worker is a flight-recorder trigger: the artifact names
+        # the peer and carries the recent event ring + metrics snapshot
+        telemetry.flight_dump(
+            "dead_peer", peer=rank, round=self.round_idx,
+            dead_peers=dead,
+        )
         self._maybe_close_round(deadline_fired=False)
 
     def _on_round_deadline(self, round_idx: int) -> None:
@@ -282,6 +300,8 @@ class FedAvgServerActor(ServerManager):
             n_results = len(self._results)
             quorum = self._quorum()
             abort = results = None
+            closed_idx = self.round_idx
+            dead = sorted(self.dead_peers)  # snapshot under the lock
             if not live:
                 abort = (
                     f"all {self.size - 1} workers died before round "
@@ -308,9 +328,17 @@ class FedAvgServerActor(ServerManager):
             if abort is not None:
                 self._abort_locked(abort)
         if abort is not None:
+            # a quorum-lost abort is a flight-recorder trigger: PR 1
+            # made it loud, this makes it debuggable
+            telemetry.METRICS.inc("round.quorum_lost_aborts")
+            telemetry.flight_dump(
+                "quorum_lost", detail=abort, round=closed_idx,
+                dead_peers=dead,
+            )
             self.finish_all()  # done unset: deploy raises the diagnostic
         else:
-            self._close_round(results)
+            self._close_round(results, closed_idx, n_live=len(live),
+                              dead=dead)
 
     def _handle_result(self, msg: Message) -> None:
         with self._lock:
@@ -330,13 +358,38 @@ class FedAvgServerActor(ServerManager):
             )
         self._maybe_close_round(deadline_fired=False)
 
-    def _close_round(self, results: dict[int, tuple[dict, float]]) -> None:
+    def _close_round(
+        self,
+        results: dict[int, tuple[dict, float]],
+        closed_idx: int,
+        n_live: int | None = None,
+        dead: list[int] | None = None,
+    ) -> None:
         """Aggregate ``results`` through the SAME server_update as the
         compiled sim (reference handle_message_receive_model_from_client,
         FedAvgServerManager.py:45-82 + fedopt/FedOptAggregator.py) — the
         two paths cannot drift. With a partial cohort the weighted mean
         renormalizes over the survivors' sample counts by construction.
-        ``round_idx`` was already advanced by the caller under the lock."""
+        ``round_idx`` was already advanced by the caller under the lock;
+        ``closed_idx`` is the round these results belong to."""
+        tr = telemetry.TRACER
+        if tr is not None:
+            tr.log_round_end(closed_idx)
+        m = telemetry.METRICS
+        if m.enabled:
+            m.observe("round.wall_s", time.monotonic() - self._round_t0)
+            m.gauge("round.results", len(results))
+            if n_live is not None and n_live > len(results):
+                # live workers whose results the deadline cut out
+                m.inc("round.stragglers", n_live - len(results))
+            if len(results) < self.size - 1:
+                # fewer results than the full cohort: the weighted mean
+                # below renormalizes over the survivors' sample mass
+                m.inc("round.quorum_renormalizations")
+        telemetry.RECORDER.record(
+            "round_close", round=closed_idx, results=len(results),
+            dead_peers=dead if dead is not None else [],
+        )
         stacked = T.tree_stack(
             [results[r][0] for r in sorted(results)]
         )
@@ -402,21 +455,28 @@ class FedAvgClientActor(ClientManager):
         rng = jax.random.fold_in(
             jax.random.fold_in(self.root_key, round_idx), client_idx
         )
-        new_vars, n_k, _ = self._local_update(
-            variables,
-            self.arrays.idx[client_idx],
-            self.arrays.mask[client_idx],
-            self.arrays.x,
-            self.arrays.y,
-            rng,
-        )
+        # the np.asarray conversion blocks on the async dispatch, so the
+        # span covers the real device work, not just the enqueue
+        with telemetry.maybe_span(
+            "local_update", rank=self.rank, round=round_idx,
+            client=client_idx,
+        ):
+            new_vars, n_k, _ = self._local_update(
+                variables,
+                self.arrays.idx[client_idx],
+                self.arrays.mask[client_idx],
+                self.arrays.x,
+                self.arrays.y,
+                rng,
+            )
+            host_vars = jax.tree.map(np.asarray, new_vars)
         self.send_message(
             Message(
                 MSG_TYPE_C2S_RESULT,
                 self.rank,
                 0,
                 {
-                    KEY_MODEL_PARAMS: jax.tree.map(np.asarray, new_vars),
+                    KEY_MODEL_PARAMS: host_vars,
                     KEY_NUM_SAMPLES: float(n_k),
                     # round tag: lets the server discard a straggler's
                     # result that arrives after its round already closed

@@ -14,6 +14,7 @@ import threading
 import time
 from typing import Callable, Iterable
 
+from fedml_tpu.core import telemetry
 from fedml_tpu.core.message import (
     MSG_TYPE_FINISH,
     MSG_TYPE_HEARTBEAT,
@@ -82,6 +83,11 @@ class LivenessMonitor:
             if peer in self.dead:
                 return
             self.dead.add(peer)
+        telemetry.METRICS.inc("manager.dead_peer_events")
+        telemetry.RECORDER.record(
+            "dead_peer", peer=peer, rank=self.mgr.rank,
+            timeout_s=self.timeout_s,
+        )
         if self.on_dead is not None:
             self.on_dead(peer)
 
@@ -100,8 +106,11 @@ class LivenessMonitor:
                 self._mark_dead(peer)
                 return
             try:
+                # hb_ts: the peer's manager echoes it back so the next
+                # inbound beat closes the loop into an RTT gauge
                 self.mgr.send_message(
-                    Message(MSG_TYPE_HEARTBEAT, self.mgr.rank, peer, {})
+                    Message(MSG_TYPE_HEARTBEAT, self.mgr.rank, peer,
+                            {"hb_ts": time.monotonic()})
                 )
             except Exception:
                 # endpoint gone (socket transports raise once the
@@ -181,14 +190,37 @@ class Manager:
             MSG_TYPE_FINISH, lambda msg: self.finish()
         )
         # liveness/handshake beacons are protocol-level: every actor
-        # accepts them silently (their side effect — the last-seen
-        # refresh — happens at deliver time, before dispatch)
+        # accepts them (their primary side effect — the last-seen
+        # refresh — happens at deliver time, before dispatch; the
+        # handler only services the RTT ping/echo)
         self.register_message_receive_handler(
-            MSG_TYPE_HEARTBEAT, lambda msg: None
+            MSG_TYPE_HEARTBEAT, self._on_heartbeat
         )
         self.register_message_receive_handler(
             MSG_TYPE_S2C_ACK, lambda msg: None
         )
+
+    def _on_heartbeat(self, msg: Message) -> None:
+        """Ping/echo half of the RTT measurement: a beat carrying
+        ``hb_ts`` is echoed back (``hb_echo``); an echo of OUR beat
+        closes the loop into a per-peer RTT gauge. Echoes carry no
+        ``hb_ts``, so the exchange terminates after one hop."""
+        hb_echo = msg.get("hb_echo")
+        if hb_echo is not None:
+            telemetry.METRICS.gauge(
+                f"manager.heartbeat_rtt_s.peer{msg.sender}",
+                time.monotonic() - float(hb_echo),
+            )
+            return
+        hb_ts = msg.get("hb_ts")
+        if hb_ts is not None:
+            try:
+                self.send_message(
+                    Message(MSG_TYPE_HEARTBEAT, self.rank, msg.sender,
+                            {"hb_echo": hb_ts})
+                )
+            except Exception:
+                pass  # peer flapped mid-echo; its watchdog will notice
 
     def register_message_receive_handler(
         self, msg_type: int, handler: Handler
@@ -201,9 +233,41 @@ class Manager:
             raise KeyError(
                 f"rank {self.rank}: no handler for msg_type {msg_type}"
             )
-        handler(msg)
+        tr = telemetry.TRACER
+        trace = getattr(msg, "trace", None) if tr is not None else None
+        if trace is None:
+            handler(msg)
+            return
+        # bind the inbound trace id for the handler's duration: any
+        # message the handler sends inherits it, which is what connects
+        # a server's round-sync to the client's result across processes
+        telemetry.set_current_trace(trace[0])
+        try:
+            with tr.span(
+                f"handle:{msg_type}", rank=self.rank, trace_id=trace[0],
+                parent_span=trace[1], sender=msg.sender,
+                msg_type=msg_type,
+            ):
+                handler(msg)
+        finally:
+            telemetry.set_current_trace(None)
 
     def send_message(self, msg: Message) -> None:
+        tr = telemetry.TRACER
+        if tr is not None and msg.msg_type != MSG_TYPE_HEARTBEAT:
+            # heartbeats stay untraced: a 2 s beacon cadence would bury
+            # the work-message timeline under protocol noise
+            if getattr(msg, "trace", None) is None:
+                tid = telemetry.current_trace()
+                msg.trace = (
+                    tid if tid is not None else telemetry.new_trace_id(),
+                    telemetry.new_span_id(),
+                )
+            tr.event(
+                "msg_send", rank=self.rank, trace_id=msg.trace[0],
+                span_id=msg.trace[1], receiver=msg.receiver,
+                msg_type=msg.msg_type,
+            )
         self.transport.send_message(msg)
 
     def enable_liveness(

@@ -67,6 +67,12 @@ class Message:
     sender: int
     receiver: int
     payload: dict[str, Any] = dataclasses.field(default_factory=dict)
+    # cross-process trace context ``(trace_id, span_id)`` — stamped by
+    # the sending Manager when tracing is enabled (None otherwise: the
+    # disabled path adds no per-message allocation), carried through the
+    # wire codec so a send on rank 0 correlates with its deliver on
+    # rank 1 (docs/OBSERVABILITY.md)
+    trace: tuple[str, str] | None = None
 
     def get(self, key: str, default=None):
         return self.payload.get(key, default)
@@ -81,7 +87,8 @@ class Message:
             lambda v: np.asarray(v) if isinstance(v, jax.Array) else v,
             self.payload,
         )
-        return Message(self.msg_type, self.sender, self.receiver, payload)
+        return Message(self.msg_type, self.sender, self.receiver, payload,
+                       trace=self.trace)
 
     def encode_parts(self) -> tuple[bytes, bytes]:
         """Split encoding: ``(meta, tensor_frame)``. Bulk tensors ride the
@@ -108,7 +115,8 @@ class Message:
 
         payload = jax.tree.map(strip, host.payload)
         meta = pickle.dumps(
-            Message(self.msg_type, self.sender, self.receiver, payload),
+            Message(self.msg_type, self.sender, self.receiver, payload,
+                    trace=self.trace),
             protocol=5,
         )
         frame = TensorCodec().pack(arrays) if arrays else b""

@@ -1,0 +1,349 @@
+"""Runtime telemetry: metrics registry, trace context, flight recorder.
+
+PR 1 made federated rounds survive drops, stragglers, and crashed
+clients — but every one of those events was invisible: transports
+counted nothing, the :class:`~fedml_tpu.core.tracing.Tracer` was wired
+into nothing, and a quorum-lost abort left no artifact to debug from.
+This module is the process-wide telemetry spine the rest of the runtime
+hangs off (docs/OBSERVABILITY.md):
+
+- :class:`MetricsRegistry` — thread-safe counters / gauges / histograms
+  with a ``snapshot()``. One process-global instance (:data:`METRICS`)
+  is instrumented into every transport (messages/bytes sent+received,
+  retry attempts and exhaustions, reconnects, chaos faults), the manager
+  (heartbeat RTT, dead-peer events, inbox depth) and the distributed
+  round loop (wall time, stragglers, quorum renormalizations).
+- trace context — ``(trace_id, span_id)`` pairs ride on
+  :class:`~fedml_tpu.core.message.Message` envelopes; the thread-local
+  *current trace* set at dispatch time makes a handler's outbound sends
+  inherit the inbound message's trace id, so a send on rank 0 connects
+  to its deliver (and the work it caused) on rank 1 across process
+  boundaries. ``scripts/merge_trace.py`` folds the per-rank span dumps
+  into one Perfetto-loadable Chrome trace (pid = rank).
+- :class:`FlightRecorder` — a bounded ring of recent telemetry events,
+  dumped to ``telemetry_dir`` on dead-peer detection, quorum-lost abort,
+  and unhandled crash (sys/threading excepthooks), turning PR 1's loud
+  failures into debuggable artifacts.
+
+Disabled is the default and costs nothing per message: :data:`METRICS`
+starts ``enabled=False`` (every ``inc``/``gauge``/``observe`` early-
+returns), :data:`TRACER` is ``None`` (all tracing sites are guarded by
+an ``is not None`` check and allocate no ids), and the recorder ring
+accepts nothing. :func:`configure` — called by ``run.py`` under
+``--telemetry_dir``/``--trace`` and by ``deploy.run_role`` — switches
+the plane on for THIS process.
+
+The reference leans on wandb logs and grep-able ``--Benchmark`` lines
+(SURVEY.md §5.5); per-rank device/host timelines that line up are the
+FedJAX-style stronger form (arxiv 2108.02117), and the transport byte
+accounting is what Smart-NIC FL serving work optimizes against (arxiv
+2307.06561).
+"""
+
+from __future__ import annotations
+
+import atexit
+import collections
+import json
+import os
+import sys
+import threading
+import time
+import uuid
+from typing import Any
+
+from fedml_tpu.core.tracing import Tracer
+
+
+class MetricsRegistry:
+    """Thread-safe counters / gauges / histograms.
+
+    Names are flat dotted strings (vocabulary in docs/OBSERVABILITY.md).
+    Histograms keep count/sum/min/max plus power-of-two bucket counts —
+    enough for a round-latency distribution without per-sample storage.
+    All writes no-op while ``enabled`` is False, so the disabled hot
+    path is one attribute check.
+    """
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        self._counters: dict[str, float] = {}
+        self._gauges: dict[str, float] = {}
+        self._hists: dict[str, dict[str, Any]] = {}
+
+    def inc(self, name: str, value: float = 1) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + value
+
+    def gauge(self, name: str, value: float) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._gauges[name] = float(value)
+
+    def observe(self, name: str, value: float) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = {
+                    "count": 0, "sum": 0.0,
+                    "min": float("inf"), "max": float("-inf"),
+                    "buckets": {},
+                }
+            h["count"] += 1
+            h["sum"] += value
+            h["min"] = min(h["min"], value)
+            h["max"] = max(h["max"], value)
+            # power-of-two bucket upper bounds: le_2^k for the smallest
+            # k with value <= 2^k (k in [-20, 20], clamped)
+            k = -20
+            while k < 20 and value > 2.0 ** k:
+                k += 1
+            key = f"le_2^{k}"
+            h["buckets"][key] = h["buckets"].get(key, 0) + 1
+
+    def counter(self, name: str) -> float:
+        with self._lock:
+            return self._counters.get(name, 0)
+
+    def snapshot(self) -> dict[str, Any]:
+        """Deep-ish copy safe to mutate / serialize."""
+        with self._lock:
+            return {
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "histograms": {
+                    k: {**v, "buckets": dict(v["buckets"])}
+                    for k, v in self._hists.items()
+                },
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._hists.clear()
+
+
+class FlightRecorder:
+    """Bounded ring of recent telemetry events + crash-artifact writer.
+
+    ``record`` is cheap (deque append under a lock) and a no-op while
+    disabled. ``dump`` writes the ring, the metrics snapshot, and the
+    trigger reason to ``<dir>/flight_rank<r>_<n>_<reason>.json`` —
+    monotonic ``n`` so multiple triggers in one process never clobber
+    each other.
+    """
+
+    def __init__(self, capacity: int = 1024, enabled: bool = False):
+        self.enabled = enabled
+        self.dir: str | None = None
+        self.rank = 0
+        self._ring: collections.deque = collections.deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._dumps = 0
+
+    def record(self, kind: str, **fields) -> None:
+        if not self.enabled:
+            return
+        ev = {"kind": kind, "ts": time.time(), **fields}
+        with self._lock:
+            self._ring.append(ev)
+
+    def dump(self, reason: str, **fields) -> str | None:
+        """Write the flight artifact; returns its path (None if no
+        telemetry dir is configured)."""
+        self.record(reason, **fields)
+        if self.dir is None:
+            return None
+        with self._lock:
+            self._dumps += 1
+            n = self._dumps
+            events = list(self._ring)
+        path = os.path.join(
+            self.dir, f"flight_rank{self.rank}_{n}_{reason}.json"
+        )
+        data = {
+            "reason": reason,
+            "rank": self.rank,
+            "ts": time.time(),
+            **fields,
+            "events": events,
+            "metrics": METRICS.snapshot(),
+        }
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(data, f, indent=2, default=repr)
+        os.replace(tmp, path)
+        return path
+
+
+#: Process-global registry — disabled until :func:`configure`.
+METRICS = MetricsRegistry(enabled=False)
+#: Process-global flight recorder — disabled until :func:`configure`.
+RECORDER = FlightRecorder()
+#: Process-global tracer — ``None`` until :func:`configure(trace=True)`.
+#: Every tracing site guards on ``TRACER is not None`` so the disabled
+#: path allocates nothing per message.
+TRACER: Tracer | None = None
+
+_DIR: str | None = None
+_RANK = 0
+_tls = threading.local()
+_hooks_installed = False
+
+
+# -- trace context -----------------------------------------------------------
+
+
+def new_trace_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def new_span_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def set_current_trace(trace_id: str | None) -> None:
+    """Bind the thread's current trace id (set at message dispatch so a
+    handler's outbound sends inherit the inbound trace)."""
+    _tls.trace = trace_id
+
+
+def current_trace() -> str | None:
+    return getattr(_tls, "trace", None)
+
+
+def maybe_span(name: str, **attrs):
+    """A tracer span when tracing is on, a null context otherwise."""
+    import contextlib
+
+    tr = TRACER
+    if tr is None:
+        return contextlib.nullcontext()
+    return tr.span(name, **attrs)
+
+
+def flight_dump(reason: str, **fields) -> str | None:
+    """Record + dump a flight artifact (no-op without a telemetry dir).
+    The triggers — dead peers, quorum-lost aborts, crashes — call this;
+    see docs/OBSERVABILITY.md."""
+    return RECORDER.dump(reason, **fields)
+
+
+# -- lifecycle ---------------------------------------------------------------
+
+
+def default_dir(out_dir: str, run_name: str) -> str:
+    """Where artifacts land when tracing is requested without an
+    explicit ``--telemetry_dir`` (one derivation shared by the sim and
+    role CLI paths so they can never drift)."""
+    return os.path.join(out_dir, run_name, "telemetry")
+
+
+def configure(
+    telemetry_dir: str | None = None,
+    rank: int = 0,
+    trace: bool = True,
+    jax_profiler: bool = False,
+    flight_capacity: int = 1024,
+) -> None:
+    """Enable telemetry for THIS process (idempotent).
+
+    - metrics counting switches on unconditionally;
+    - ``trace=True`` creates the process tracer (optionally wrapping
+      spans in ``jax.profiler.TraceAnnotation`` so device work lines up
+      with host spans in a jax profile);
+    - a ``telemetry_dir`` additionally arms the flight recorder, the
+      crash hooks (sys/threading excepthook -> flight dump), and the
+      exit flush that writes ``trace_rank<r>.json`` +
+      ``metrics_rank<r>.json``.
+    """
+    global TRACER, _DIR, _RANK
+    _RANK = rank
+    METRICS.enabled = True
+    RECORDER.rank = rank
+    if trace:
+        if TRACER is None:
+            TRACER = Tracer(use_jax_profiler=jax_profiler, rank=rank)
+        else:
+            TRACER.rank = rank
+    if telemetry_dir:
+        os.makedirs(telemetry_dir, exist_ok=True)
+        _DIR = telemetry_dir
+        RECORDER.dir = telemetry_dir
+        RECORDER.enabled = True
+        RECORDER._ring = collections.deque(
+            RECORDER._ring, maxlen=flight_capacity
+        )
+        _install_hooks()
+
+
+def flush() -> None:
+    """Write the per-rank trace dump and metrics snapshot now (also runs
+    at interpreter exit once a telemetry dir is configured)."""
+    if _DIR is None:
+        return
+    if TRACER is not None and TRACER.events:
+        TRACER.dump(os.path.join(_DIR, f"trace_rank{_RANK}.json"))
+    path = os.path.join(_DIR, f"metrics_rank{_RANK}.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(METRICS.snapshot(), f, indent=2, default=repr)
+    os.replace(tmp, path)
+
+
+def shutdown() -> None:
+    """Flush, then return to the all-disabled state (test isolation)."""
+    global TRACER, _DIR
+    flush()
+    METRICS.enabled = False
+    METRICS.reset()
+    RECORDER.enabled = False
+    RECORDER.dir = None
+    RECORDER._ring.clear()
+    RECORDER._dumps = 0
+    TRACER = None
+    _DIR = None
+    set_current_trace(None)
+
+
+def _install_hooks() -> None:
+    """Crash hooks + exit flush, installed once per process. They read
+    the module globals at fire time, so a later :func:`shutdown` renders
+    them inert."""
+    global _hooks_installed
+    if _hooks_installed:
+        return
+    _hooks_installed = True
+
+    prev_exc = sys.excepthook
+
+    def on_crash(exc_type, exc, tb):
+        if RECORDER.enabled:
+            flight_dump("crash", error=repr(exc),
+                        error_type=exc_type.__name__)
+            flush()
+        prev_exc(exc_type, exc, tb)
+
+    sys.excepthook = on_crash
+
+    prev_thread_exc = threading.excepthook
+
+    def on_thread_crash(args):
+        if RECORDER.enabled and args.exc_type is not SystemExit:
+            flight_dump(
+                "crash",
+                error=repr(args.exc_value),
+                error_type=args.exc_type.__name__,
+                thread=getattr(args.thread, "name", None),
+            )
+        prev_thread_exc(args)
+
+    threading.excepthook = on_thread_crash
+    atexit.register(flush)

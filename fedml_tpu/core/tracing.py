@@ -6,51 +6,96 @@ Reference: wall-clock timers around aggregation
 (``fedml_core/distributed/communication/utils.py:4-18``). Here the same
 API feeds a structured in-memory trace (exportable to JSON) and optionally
 ``jax.profiler`` ranges so device timelines line up with host spans.
+
+Every event carries a wall-clock ``ts`` (epoch seconds at start), the
+emitting ``rank`` and thread id — the coordinates
+``scripts/merge_trace.py`` needs to fold per-rank dumps into one
+Chrome-trace-event timeline (Perfetto-loadable, pid = rank, tid =
+thread). Cross-process correlation ids (``trace_id``/``span_id``) ride
+in as ordinary attrs from the telemetry layer
+(:mod:`fedml_tpu.core.telemetry`).
 """
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import json
 import logging
+import os
+import threading
 import time
 from typing import Any
 
 
 class Tracer:
-    """Span collector with the reference's tick/tock vocabulary."""
+    """Span collector with the reference's tick/tock vocabulary.
 
-    def __init__(self, use_jax_profiler: bool = False):
-        self.events: list[dict[str, Any]] = []
-        self._open: dict[str, float] = {}
+    ``events`` is a bounded ring (``max_events``, default 200k): a
+    multi-thousand-round deployment with tracing left on keeps the most
+    recent window instead of growing RSS without bound; ``dropped``
+    counts evictions and is recorded in :meth:`dump`.
+    """
+
+    def __init__(self, use_jax_profiler: bool = False,
+                 rank: int | None = None, max_events: int = 200_000):
+        self.events: collections.deque[dict[str, Any]] = collections.deque(
+            maxlen=max_events
+        )
+        self.dropped = 0
+        self._open: dict[str, tuple[float, float]] = {}
         self._jax = use_jax_profiler
+        self.rank = rank
+        self._lock = threading.Lock()
+
+    def _emit(self, ev: dict[str, Any]) -> None:
+        with self._lock:
+            if len(self.events) == self.events.maxlen:
+                self.dropped += 1
+            self.events.append(ev)
+
+    def _base(self, kind: str, ts: float, seconds: float,
+              attrs: dict) -> dict[str, Any]:
+        ev = {
+            "kind": kind,
+            "ts": ts,
+            "seconds": seconds,
+            "rank": self.rank,
+            "tid": threading.get_ident() & 0xFFFFFFFF,
+        }
+        ev.update(attrs)  # attrs may override rank (shared-process worlds)
+        return ev
 
     # -- reference-shaped API (communication/utils.py:4-18) ----------------
     def log_communication_tick(self, sender, receiver, tag: str = ""):
-        self._open[f"comm:{sender}->{receiver}:{tag}"] = time.perf_counter()
+        self._open[f"comm:{sender}->{receiver}:{tag}"] = (
+            time.perf_counter(), time.time()
+        )
         logging.debug("--Benchmark tick comm %s->%s %s", sender, receiver, tag)
 
     def log_communication_tock(self, sender, receiver, tag: str = ""):
         key = f"comm:{sender}->{receiver}:{tag}"
         t0 = self._open.pop(key, None)
         if t0 is not None:
-            dt = time.perf_counter() - t0
-            self.events.append(
-                {"kind": "comm", "sender": sender, "receiver": receiver,
-                 "tag": tag, "seconds": dt}
-            )
+            dt = time.perf_counter() - t0[0]
+            self._emit(self._base(
+                "comm", t0[1], dt,
+                {"sender": sender, "receiver": receiver, "tag": tag},
+            ))
             logging.debug("--Benchmark tock comm %s %fs", key, dt)
 
     def log_round_start(self, round_idx: int):
-        self._open[f"round:{round_idx}"] = time.perf_counter()
+        self._open[f"round:{round_idx}"] = (
+            time.perf_counter(), time.time()
+        )
 
     def log_round_end(self, round_idx: int):
         t0 = self._open.pop(f"round:{round_idx}", None)
         if t0 is not None:
-            self.events.append(
-                {"kind": "round", "round": round_idx,
-                 "seconds": time.perf_counter() - t0}
-            )
+            self._emit(self._base(
+                "round", t0[1], time.perf_counter() - t0[0],
+                {"round": round_idx},
+            ))
 
     # -- generic spans -----------------------------------------------------
     @contextlib.contextmanager
@@ -61,17 +106,39 @@ class Tracer:
             else contextlib.nullcontext()
         )
         t0 = time.perf_counter()
-        with ctx:
-            yield
-        self.events.append(
-            {"kind": "span", "name": name,
-             "seconds": time.perf_counter() - t0, **attrs}
-        )
+        ts = time.time()
+        err: BaseException | None = None
+        try:
+            with ctx:
+                yield
+        except BaseException as e:
+            # the span record must survive a raising body: a failing
+            # round still leaves its timing (tagged with the error)
+            # instead of silently dropping the event
+            err = e
+            raise
+        finally:
+            ev = self._base(
+                "span", ts, time.perf_counter() - t0,
+                {"name": name, **attrs},
+            )
+            if err is not None:
+                ev["error"] = repr(err)
+            self._emit(ev)
+
+    def event(self, name: str, **attrs):
+        """Instant event (zero duration) — message sends/delivers, fault
+        injections, dead-peer marks."""
+        self._emit(self._base(
+            "event", time.time(), 0.0, {"name": name, **attrs}
+        ))
 
     # -- reporting ---------------------------------------------------------
     def summary(self) -> dict[str, dict]:
         agg: dict[str, dict] = {}
-        for e in self.events:
+        with self._lock:
+            events = list(self.events)
+        for e in events:
             key = e.get("name") or e["kind"]
             s = agg.setdefault(key, {"count": 0, "total_s": 0.0})
             s["count"] += 1
@@ -81,5 +148,16 @@ class Tracer:
         return agg
 
     def dump(self, path: str):
-        with open(path, "w") as f:
-            json.dump(self.events, f, indent=2)
+        with self._lock:
+            events = list(self.events)
+            dropped = self.dropped
+        # atomic replace: a crash mid-flush (or a concurrent
+        # merge_trace.py read) must never observe a truncated dump —
+        # this artifact exists precisely for crash debugging
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(
+                {"rank": self.rank, "dropped": dropped, "events": events},
+                f, indent=2, default=repr,
+            )
+        os.replace(tmp, path)

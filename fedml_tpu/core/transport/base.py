@@ -17,6 +17,7 @@ import queue
 import threading
 from typing import Callable, Protocol
 
+from fedml_tpu.core import telemetry
 from fedml_tpu.core.message import Message
 
 
@@ -26,6 +27,10 @@ class Observer(Protocol):
 
 class BaseTransport(abc.ABC):
     """4-method contract + shared inbox/dispatch machinery."""
+
+    # cleared on a wrapped inner transport (ChaosTransport) so the one
+    # message is not trace-marked/gauged twice on its way to the actor
+    _telemetry_deliver = True
 
     def __init__(self, rank: int):
         self.rank = rank
@@ -37,6 +42,9 @@ class BaseTransport(abc.ABC):
         # while the dispatch thread is busy inside a long handler (a
         # client mid-local-update would otherwise look dead to itself)
         self._deliver_hooks: list[Callable[[Message], None]] = []
+        # precomputed so the enabled hot path allocates no per-message
+        # strings (docs/OBSERVABILITY.md vocabulary)
+        self._inbox_gauge = f"transport.inbox_depth.rank{rank}"
 
     # -- to implement ------------------------------------------------------
     @abc.abstractmethod
@@ -56,8 +64,39 @@ class BaseTransport(abc.ABC):
     def add_deliver_hook(self, hook: Callable[[Message], None]) -> None:
         self._deliver_hooks.append(hook)
 
+    # -- telemetry (docs/OBSERVABILITY.md) ---------------------------------
+    def note_send(self, msg: Message, nbytes: int) -> None:
+        """Account one outbound wire frame. Every concrete transport
+        calls this once per send with the encoded frame size."""
+        m = telemetry.METRICS
+        if m.enabled:
+            m.inc("transport.messages_sent")
+            m.inc("transport.bytes_sent", nbytes)
+
+    def note_receive(self, nbytes: int) -> None:
+        """Account one inbound wire frame — called at the transport's
+        decode site (real I/O), NOT in :meth:`deliver`, so a wrapping
+        transport (chaos) never double-counts."""
+        m = telemetry.METRICS
+        if m.enabled:
+            m.inc("transport.messages_received")
+            m.inc("transport.bytes_received", nbytes)
+
     def deliver(self, msg: Message) -> None:
         """Called by receiver machinery (or peers, for loopback)."""
+        if self._telemetry_deliver:
+            tr = telemetry.TRACER
+            if tr is not None:
+                trace = getattr(msg, "trace", None)
+                if trace is not None:
+                    tr.event(
+                        "msg_deliver", rank=self.rank, trace_id=trace[0],
+                        span_id=trace[1], sender=msg.sender,
+                        msg_type=msg.msg_type,
+                    )
+            m = telemetry.METRICS
+            if m.enabled:
+                m.gauge(self._inbox_gauge, self._inbox.qsize())
         for hook in self._deliver_hooks:
             hook(msg)
         self._inbox.put(msg)

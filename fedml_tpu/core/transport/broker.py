@@ -39,6 +39,7 @@ import struct
 import threading
 from typing import Callable
 
+from fedml_tpu.core import telemetry
 from fedml_tpu.core.transport.retry import (
     RetryExhausted,
     RetryPolicy,
@@ -327,6 +328,11 @@ class RemoteTopicBus:
                     # flapping): the SUB replay inside _dial_locked and
                     # the resend below stay inside this loop so no bare
                     # OSError escapes to publish()/subscribe() callers
+                    telemetry.METRICS.inc("transport.reconnects")
+                    telemetry.RECORDER.record(
+                        "broker_redial",
+                        broker=f"{self.host}:{self.port}",
+                    )
                     try:
                         self._sock.close()
                     except OSError:

@@ -41,6 +41,7 @@ import os
 import random
 import threading
 
+from fedml_tpu.core import telemetry
 from fedml_tpu.core.message import (
     KEY_ROUND,
     MSG_TYPE_C2S_READY,
@@ -131,7 +132,17 @@ class ChaosTransport(BaseTransport):
             "sent": 0, "dropped": 0, "delayed": 0, "duplicated": 0,
             "reordered": 0,
         }
+        # the inner transport still counts wire bytes at its decode
+        # site, but deliver-time telemetry (trace marks, inbox gauge)
+        # belongs to THIS transport — the one the actor drains
+        inner._telemetry_deliver = False
         inner.add_observer(_InboundShim(self))
+
+    def _stat(self, key: str, n: int = 1) -> None:
+        """Bump a fault counter in both the local stats dict (tests)
+        and the process metrics registry (docs/OBSERVABILITY.md)."""
+        self.stats[key] += n
+        telemetry.METRICS.inc("chaos." + key, n)
 
     # -- receive path ------------------------------------------------------
     def start(self) -> None:
@@ -149,6 +160,10 @@ class ChaosTransport(BaseTransport):
 
     def _crash(self) -> None:
         self.crashed.set()
+        telemetry.METRICS.inc("chaos.crashes")
+        telemetry.RECORDER.record(
+            "chaos_crash", rank=self.rank, mode=self.policy.crash_mode
+        )
         if self.policy.crash_mode == "exit":
             # the deterministic `kill -9`: no atexit, no cleanup, no
             # FINISH — exactly what a preempted spot VM looks like
@@ -180,14 +195,14 @@ class ChaosTransport(BaseTransport):
                 self._rng.random() for _ in range(5)
             )
         if r_drop < p.drop_prob:
-            self.stats["dropped"] += 1
+            self._stat("dropped")
             return
         if r_reorder < p.reorder_prob:
             swap = None
             with self._held_lock:
                 if self._held is None:
                     self._held = msg  # ships after the NEXT send
-                    self.stats["reordered"] += 1
+                    self._stat("reordered")
                     # a tail message must not be held forever if no
                     # successor ever comes
                     t = threading.Timer(0.25, self._flush_held)
@@ -203,7 +218,7 @@ class ChaosTransport(BaseTransport):
         if r_delay < p.delay_prob:
             delay = p.delay_min_s + r_u * (p.delay_max_s - p.delay_min_s)
         if r_dup < p.dup_prob:
-            self.stats["duplicated"] += 1
+            self._stat("duplicated")
             self._dispatch(msg, delay)
             self._dispatch(msg, delay)
             return
@@ -217,7 +232,7 @@ class ChaosTransport(BaseTransport):
         if delay is None:
             self._send_now(msg)
             return
-        self.stats["delayed"] += 1
+        self._stat("delayed")
         t = threading.Timer(
             delay, self._send_now, args=(msg,), kwargs={
                 "swallow_errors": True}
@@ -234,7 +249,7 @@ class ChaosTransport(BaseTransport):
     def _send_now(self, msg: Message, swallow_errors: bool = False) -> None:
         if self.crashed.is_set():
             return
-        self.stats["sent"] += 1
+        self._stat("sent")
         if not swallow_errors:
             self.inner.send_message(msg)
             return
@@ -244,7 +259,7 @@ class ChaosTransport(BaseTransport):
             # anyway, and a timer thread has no caller to raise into
             self.inner.send_message(msg)
         except Exception:
-            self.stats["dropped"] += 1
+            self._stat("dropped")
 
     def stop(self) -> None:
         super().stop()

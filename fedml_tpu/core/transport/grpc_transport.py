@@ -13,6 +13,7 @@ from __future__ import annotations
 import threading
 from concurrent import futures
 
+from fedml_tpu.core import telemetry
 from fedml_tpu.core.message import Message
 from fedml_tpu.core.transport.base import BaseTransport
 from fedml_tpu.core.transport.retry import RetryPolicy, call_with_retry
@@ -48,6 +49,7 @@ class GrpcTransport(BaseTransport):
         grpc = self._grpc
 
         def handler(request: bytes, context) -> bytes:
+            self.note_receive(len(request))
             self.deliver(Message.decode(request))
             return b""
 
@@ -91,6 +93,7 @@ class GrpcTransport(BaseTransport):
         with self._chan_lock:
             ch = self._channels.pop(rank, None)
         if ch is not None:
+            telemetry.METRICS.inc("transport.reconnects")
             ch.close()
 
     def send_message(self, msg: Message) -> None:
@@ -101,6 +104,7 @@ class GrpcTransport(BaseTransport):
         between attempts (a broken subchannel otherwise stays in
         TRANSIENT_FAILURE for its own internal backoff window)."""
         data = msg.encode()
+        self.note_send(msg, len(data))
         rank = msg.receiver
         # per-RPC deadline: a FRACTION of the overall budget so a hung
         # (not refusing) server leaves room for the rebuilt-channel

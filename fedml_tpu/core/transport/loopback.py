@@ -29,4 +29,7 @@ class LoopbackTransport(BaseTransport):
     def send_message(self, msg: Message) -> None:
         # round-trip through the wire codec to keep tests honest
         data = msg.encode()
-        self.hub.transports[msg.receiver].deliver(Message.decode(data))
+        self.note_send(msg, len(data))
+        peer = self.hub.transports[msg.receiver]
+        peer.note_receive(len(data))
+        peer.deliver(Message.decode(data))

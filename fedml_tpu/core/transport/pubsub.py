@@ -140,6 +140,7 @@ class PubSubTransport(BaseTransport):
         )
 
     def _on_message(self, topic: str, payload: bytes) -> None:
+        self.note_receive(len(payload))
         self.deliver(self._inflate(Message.decode(payload)))
 
     def _deflate(self, msg: Message) -> Message:
@@ -149,9 +150,9 @@ class PubSubTransport(BaseTransport):
         return msg
 
     def send_message(self, msg: Message) -> None:
-        self.bus.publish(
-            self._topic_for(msg.receiver), self._deflate(msg).encode()
-        )
+        data = self._deflate(msg).encode()
+        self.note_send(msg, len(data))
+        self.bus.publish(self._topic_for(msg.receiver), data)
 
 
 class PubSubBlobTransport(PubSubTransport):
@@ -185,7 +186,8 @@ class PubSubBlobTransport(PubSubTransport):
         }
         payload[KEY_BLOB] = key
         payload[KEY_BLOB_URL] = url
-        return Message(msg.msg_type, msg.sender, msg.receiver, payload)
+        return Message(msg.msg_type, msg.sender, msg.receiver, payload,
+                       trace=msg.trace)
 
     def _inflate(self, msg: Message) -> Message:
         key = msg.get(KEY_BLOB)
@@ -202,4 +204,5 @@ class PubSubBlobTransport(PubSubTransport):
             if k not in (KEY_BLOB, KEY_BLOB_URL)
         }
         payload[KEY_MODEL_PARAMS] = carrier.get(KEY_MODEL_PARAMS)
-        return Message(msg.msg_type, msg.sender, msg.receiver, payload)
+        return Message(msg.msg_type, msg.sender, msg.receiver, payload,
+                       trace=msg.trace)

@@ -20,6 +20,8 @@ import threading
 import time
 from typing import Callable, Iterable
 
+from fedml_tpu.core import telemetry
+
 
 @dataclasses.dataclass(frozen=True)
 class RetryPolicy:
@@ -75,6 +77,10 @@ def call_with_retry(
             return fn()
         except retry_on as err:
             last = err
+            telemetry.METRICS.inc("transport.retry_attempts")
+            telemetry.RECORDER.record(
+                "retry", op=describe, attempt=attempts, error=repr(err)
+            )
             if cleanup is not None:
                 cleanup()
             pause = policy.delay(attempt, rng)
@@ -86,6 +92,10 @@ def call_with_retry(
                     break
             else:
                 time.sleep(pause)
+    telemetry.METRICS.inc("transport.retry_exhausted")
+    telemetry.RECORDER.record(
+        "retry_exhausted", op=describe, attempts=attempts, error=repr(last)
+    )
     raise RetryExhausted(
         f"{describe} failed after {attempts} attempts "
         f"(budget {policy.max_attempts} / {policy.deadline_s}s): {last!r}"

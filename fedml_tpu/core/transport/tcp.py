@@ -13,6 +13,7 @@ import socket
 import struct
 import threading
 
+from fedml_tpu.core import telemetry
 from fedml_tpu.core.message import Message
 from fedml_tpu.core.transport.base import BaseTransport
 from fedml_tpu.core.transport.retry import RetryPolicy, call_with_retry
@@ -98,6 +99,7 @@ class TcpTransport(BaseTransport):
                 data = _recv_exact(conn, length)
                 if data is None:
                     return
+                self.note_receive(_HDR.size + length)
                 self.deliver(Message.decode(data))
 
     # -- send side ---------------------------------------------------------
@@ -110,12 +112,14 @@ class TcpTransport(BaseTransport):
 
     def send_message(self, msg: Message) -> None:
         data = msg.encode()
+        self.note_send(msg, _HDR.size + len(data))
         self._send_wire(msg.receiver, _HDR.pack(len(data)) + data)
 
     def _evict(self, rank: int) -> None:
         with self._lock:
             sock = self._conns.pop(rank, None)
         if sock is not None:
+            telemetry.METRICS.inc("transport.reconnects")
             try:
                 sock.close()
             except OSError:

@@ -57,6 +57,7 @@ class TensorRpcTransport(TcpTransport):
         wire = (
             _HDR.pack(len(frame)) + frame + _HDR.pack(len(meta)) + meta
         )
+        self.note_send(msg, len(wire))
         self._send_wire(rank, wire)
 
     def _read_loop(self, conn: socket.socket) -> None:
@@ -76,6 +77,7 @@ class TensorRpcTransport(TcpTransport):
                 meta = _recv_exact(conn, meta_len)
                 if meta is None:
                     return
+                self.note_receive(2 * _HDR.size + frame_len + meta_len)
                 self.deliver(Message.from_parts(meta, frame))
 
 

@@ -58,10 +58,10 @@ import jax
 import numpy as np
 
 from fedml_tpu.config import ExperimentConfig
+from fedml_tpu.core import telemetry
 from fedml_tpu.core.manager import Manager, ServerManager, create_transport
 from fedml_tpu.core.message import (
     MSG_TYPE_C2S_READY,
-    MSG_TYPE_HEARTBEAT,
     MSG_TYPE_S2C_ACK,
     Message,
 )
@@ -97,6 +97,13 @@ class DeployConfig:
     round_deadline_s: float | None = None
     # seeded fault injection for THIS rank (None/disabled = real traffic)
     fault: FaultPolicy | None = None
+    # -- telemetry (docs/OBSERVABILITY.md) ---------------------------------
+    # directory for THIS rank's artifacts: trace_rank<r>.json span dump,
+    # metrics_rank<r>.json snapshot, flight_rank<r>_*.json crash rings;
+    # None + trace=False keeps the telemetry plane fully disabled
+    telemetry_dir: str | None = None
+    trace: bool = False  # span tracing without (or in addition to) a dir
+    trace_jax: bool = False  # wrap spans in jax.profiler.TraceAnnotation
 
 
 def load_ip_config(path: str) -> dict[int, tuple[str, int]]:
@@ -154,11 +161,14 @@ def _server_dead_peer_cb(server: ServerManager):
     def on_dead(rank: int) -> None:
         handler = getattr(server, "on_peer_dead", None)
         if handler is not None:
-            handler(rank)
+            handler(rank)  # dumps its own flight artifact
             return
         server._liveness_failure = (
             f"client rank {rank} became unreachable mid-run "
             "(heartbeats stopped)"
+        )
+        telemetry.flight_dump(
+            "dead_peer", peer=rank, detail=server._liveness_failure
         )
         server.transport.stop()
 
@@ -197,22 +207,13 @@ def _serve_with_ready_barrier(
                 )
             kickoff()
 
-    def on_beat(msg: Message) -> None:
-        # echo: a client's liveness view must be satisfiable BEFORE the
-        # barrier completes (its watchdog arms at ACK time, but the
-        # server's own beats only start at kickoff — without the echo, a
-        # client ready early would see "silence" while the slowest rank
-        # is still importing jax, declare the server dead, and cascade
-        # the whole launch into failure)
-        try:
-            server.send_message(
-                Message(MSG_TYPE_HEARTBEAT, 0, msg.sender, {})
-            )
-        except Exception:
-            pass
-
+    # NOTE: no per-deploy heartbeat handler anymore. A client's liveness
+    # view must be satisfiable BEFORE the barrier completes (its watchdog
+    # arms at ACK time, but the server's own beats only start at kickoff)
+    # — the Manager's default handler covers this: every beat carrying
+    # ``hb_ts`` is echoed back, which both refreshes the client's
+    # last-seen table and closes its RTT gauge loop.
     server.register_message_receive_handler(MSG_TYPE_C2S_READY, on_ready)
-    server.register_message_receive_handler(MSG_TYPE_HEARTBEAT, on_beat)
     server.transport.start()
     server.run()  # blocks until the actor's finish path stops the transport
 
@@ -247,6 +248,7 @@ def _announce_until_first_message(
             "server became unreachable mid-run (no inbound traffic for "
             f"{dep.heartbeat_timeout_s}s)"
         )
+        telemetry.flight_dump("dead_peer", peer=rank, detail=failures[0])
         mgr.transport.stop()
 
     def loop() -> None:
@@ -465,6 +467,14 @@ def _run_splitnn_rank(cfg: ExperimentConfig, dep: DeployConfig) -> dict:
 
 def run_role(cfg: ExperimentConfig, dep: DeployConfig) -> dict:
     """Run THIS process's rank to completion; returns the rank summary."""
+    if dep.telemetry_dir or dep.trace or dep.trace_jax:
+        telemetry.configure(
+            # --trace without a dir still gets dumps, in the run dir
+            telemetry_dir=dep.telemetry_dir
+            or telemetry.default_dir(cfg.out_dir, cfg.run_name),
+            rank=dep.rank,
+            jax_profiler=dep.trace_jax,
+        )
     algo = cfg.fed.algorithm
     if algo in FEDAVG_FAMILY:
         return _run_fedavg_rank(cfg, dep)

@@ -15,6 +15,7 @@ import os
 from typing import Any, Callable
 
 from fedml_tpu.config import ExperimentConfig
+from fedml_tpu.core import telemetry
 from fedml_tpu.data.loaders import load_dataset
 from fedml_tpu.metrics.sink import MetricsSink
 from fedml_tpu.models import create_model
@@ -364,9 +365,16 @@ class Experiment:
                 stacklevel=2,
             )
         if not checkpointable:
-            if hasattr(sim, "run") and not isinstance(sim, type):
+            if (hasattr(sim, "run") and not isinstance(sim, type)
+                    and _run_accepts_sink(sim)):
                 try:
-                    sim.run(metrics_sink=sink)
+                    # run-shaped sims drive their own loop: one span
+                    # covers the whole trajectory (round-level spans
+                    # come from the generic loop below otherwise)
+                    with telemetry.maybe_span(
+                        "sim_run", sim=type(sim).__name__
+                    ):
+                        sim.run(metrics_sink=sink)
                     return
                 except TypeError:
                     pass
@@ -401,14 +409,15 @@ class Experiment:
     @staticmethod
     def _round_loop(sim, cfg, sink, state, start_round, ckpt):
         for r in range(start_round, cfg.fed.num_rounds):
-            if state is None:  # host-driven sims (HeteroFedGDKD)
-                m = sim.run_round()
-            else:
-                out = (
-                    sim.run_round(state, r)
-                    if _wants_round(sim) else sim.run_round(state)
-                )
-                state, m = out
+            with telemetry.maybe_span("sim_round", round=r):
+                if state is None:  # host-driven sims (HeteroFedGDKD)
+                    m = sim.run_round()
+                else:
+                    out = (
+                        sim.run_round(state, r)
+                        if _wants_round(sim) else sim.run_round(state)
+                    )
+                    state, m = out
             record = {"round": r}
             if isinstance(m, dict):
                 record.update({k: _f(v) for k, v in m.items()
@@ -446,6 +455,22 @@ def _wants_round(sim) -> bool:
         return len(inspect.signature(sim.run_round).parameters) >= 2
     except (TypeError, ValueError):
         return False
+
+
+def _run_accepts_sink(sim) -> bool:
+    """Signature gate for the ``sim.run(metrics_sink=...)`` fast path —
+    checked up front so a sim without the kwarg falls through to the
+    generic loop WITHOUT a probe call (which would record a phantom
+    error-tagged sim_run span when tracing is on)."""
+    import inspect
+
+    try:
+        params = inspect.signature(sim.run).parameters
+    except (TypeError, ValueError):
+        return True  # unintrospectable: fall back to the call probe
+    return "metrics_sink" in params or any(
+        p.kind == inspect.Parameter.VAR_KEYWORD for p in params.values()
+    )
 
 
 def _scalar(v) -> bool:

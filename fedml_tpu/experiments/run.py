@@ -70,6 +70,22 @@ def parse_args(argv=None) -> tuple[ExperimentConfig, argparse.Namespace]:
                    help="checkpoint round state every N rounds into "
                         "<out_dir>/<run>/ckpt and resume from the "
                         "latest checkpoint on restart (0 = off)")
+    # -- telemetry (docs/OBSERVABILITY.md) ---------------------------------
+    p.add_argument("--telemetry_dir", type=str, default=None,
+                   help="enable the telemetry plane and write THIS "
+                        "rank's artifacts here: trace_rank<r>.json span "
+                        "dump, metrics_rank<r>.json snapshot, "
+                        "flight_rank<r>_*.json crash rings; merge the "
+                        "span dumps with scripts/merge_trace.py")
+    p.add_argument("--trace", action="store_true",
+                   help="enable span tracing + metrics without naming a "
+                        "directory (dumps to <out_dir>/<run>/telemetry; "
+                        "implied by --telemetry_dir)")
+    p.add_argument("--trace_jax", action="store_true",
+                   help="additionally wrap tracer spans in "
+                        "jax.profiler.TraceAnnotation so device "
+                        "timelines line up with host spans in a jax "
+                        "profile")
     # -- process-separated deployment (reference mpirun/run_server.sh
     # surface: one OS process per rank; scripts/run_distributed.sh is the
     # localhost launcher) --------------------------------------------------
@@ -262,6 +278,9 @@ def _deploy_config(a) -> "DeployConfig":
         role=a.role,
         rank=rank,
         world_size=a.world_size,
+        telemetry_dir=a.telemetry_dir,
+        trace=a.trace,
+        trace_jax=a.trace_jax,
         backend=a.backend,
         ip_config=load_ip_config(a.ip_config) if a.ip_config else None,
         broker=broker,
@@ -283,8 +302,20 @@ def main(argv=None) -> int:
     if a.role is not None:
         from fedml_tpu.experiments.deploy import run_role
 
+        # telemetry for the role path is configured inside run_role
+        # (DeployConfig carries the knobs, so library callers get the
+        # same wiring as the CLI)
         print(json.dumps(run_role(cfg, _deploy_config(a)), default=float))
         return 0
+    if a.telemetry_dir or a.trace or a.trace_jax:
+        from fedml_tpu.core import telemetry
+
+        telemetry.configure(
+            telemetry_dir=a.telemetry_dir
+            or telemetry.default_dir(cfg.out_dir, cfg.run_name),
+            rank=0,
+            jax_profiler=a.trace_jax,
+        )
     summaries = Experiment(cfg, a.repetitions).run()
     for s in summaries:
         print(json.dumps(s, default=float))

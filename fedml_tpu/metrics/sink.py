@@ -2,8 +2,10 @@
 
 The reference logs everything to wandb (``wandb.log({...})`` throughout, and
 CI reads ``wandb-summary.json``; SURVEY.md §5.5). This sink provides the same
-two artifacts — a step log and a latest-value summary — as JSONL + dict, and
-can forward to wandb when it's importable.
+two artifacts — a step log and a latest-value summary — as JSONL + dict:
+``close()`` materializes the summary as ``summary.json`` next to the
+JSONL (the wandb-summary file the reference CI reads), and can forward
+to wandb when it's importable.
 """
 
 from __future__ import annotations
@@ -12,6 +14,16 @@ import json
 import os
 import time
 from typing import Any
+
+
+def _json_default(v):
+    """Serialize best-effort: floats where possible, ``repr`` otherwise
+    — a single exotic value (an array, an exception, a config object)
+    must not crash the whole metrics stream."""
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return repr(v)
 
 
 class MetricsSink:
@@ -39,12 +51,21 @@ class MetricsSink:
             {k: v for k, v in record.items() if not k.startswith("_")}
         )
         if self._fh:
-            self._fh.write(json.dumps(record, default=float) + "\n")
+            self._fh.write(json.dumps(record, default=_json_default) + "\n")
             self._fh.flush()
         if self._wandb is not None and self._wandb.run is not None:
             self._wandb.log(record)
 
     def close(self) -> None:
+        if self.path:
+            # the wandb-summary artifact (latest value per key), written
+            # next to the JSONL so CI can read one small file
+            spath = os.path.join(
+                os.path.dirname(self.path) or ".", "summary.json"
+            )
+            with open(spath, "w") as f:
+                json.dump(self.summary, f, indent=2,
+                          default=_json_default)
         if self._fh:
             self._fh.close()
             self._fh = None

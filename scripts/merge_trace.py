@@ -1,0 +1,175 @@
+"""Merge per-rank tracer dumps into ONE Chrome-trace-event JSON.
+
+Each deployment rank (or a shared-process loopback world) dumps its
+:class:`~fedml_tpu.core.tracing.Tracer` events to
+``<telemetry_dir>/trace_rank<r>.json``. This tool folds any number of
+those dumps into a single Chrome trace-event file — load it at
+https://ui.perfetto.dev (or chrome://tracing) and every rank appears as
+its own process (pid = rank), with threads as tracks and cross-process
+flow arrows connecting a message's ``msg_send`` on the sending rank to
+its ``msg_deliver`` on the receiving rank (matched by the span id the
+:class:`~fedml_tpu.core.message.Message` envelope carried over the
+wire; docs/OBSERVABILITY.md).
+
+Usage::
+
+    python scripts/merge_trace.py RUN_TELEMETRY_DIR [--out merged.json]
+    python scripts/merge_trace.py trace_rank0.json trace_rank1.json ...
+
+Timestamps are wall-clock (epoch) microseconds rebased to the earliest
+event, so ranks on the same host line up; ``X`` complete events carry
+span durations, instant events render as markers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+
+def load_rank_events(path: str) -> list[dict]:
+    """Read one tracer dump; tolerates both the current
+    ``{"rank": r, "events": [...]}`` shape and a bare legacy list."""
+    with open(path) as f:
+        data = json.load(f)
+    if isinstance(data, list):
+        events, default_rank = data, None
+    else:
+        events, default_rank = data.get("events", []), data.get("rank")
+    out = []
+    for ev in events:
+        ev = dict(ev)
+        if ev.get("rank") is None:
+            ev["rank"] = default_rank if default_rank is not None else 0
+        out.append(ev)
+    return out
+
+
+def _flow_id(span_id: str) -> int:
+    try:
+        return int(span_id, 16) & 0x7FFFFFFF
+    except (TypeError, ValueError):
+        return hash(span_id) & 0x7FFFFFFF
+
+
+_STRUCTURAL = ("kind", "ts", "seconds", "rank", "tid", "name")
+
+
+def merge(paths: list[str]) -> dict:
+    """Fold tracer dumps into a Chrome trace-event dict."""
+    events: list[dict] = []
+    for p in paths:
+        events.extend(load_rank_events(p))
+    if not events:
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+    ts0 = min(float(ev.get("ts", 0.0)) for ev in events)
+
+    trace_events: list[dict] = []
+    ranks: set[int] = set()
+    sends: dict[str, dict] = {}
+    delivers: dict[str, dict] = {}
+
+    for ev in events:
+        rank = int(ev["rank"] or 0)
+        ranks.add(rank)
+        ts_us = (float(ev.get("ts", ts0)) - ts0) * 1e6
+        dur_us = float(ev.get("seconds", 0.0)) * 1e6
+        name = ev.get("name") or ev["kind"]
+        args = {k: v for k, v in ev.items() if k not in _STRUCTURAL}
+        base = {
+            "name": name,
+            "cat": ev["kind"],
+            "pid": rank,
+            "tid": int(ev.get("tid", 0)),
+            "ts": ts_us,
+            "args": args,
+        }
+        if dur_us > 0:
+            trace_events.append({**base, "ph": "X", "dur": dur_us})
+        else:
+            trace_events.append({**base, "ph": "i", "s": "t"})
+        span_id = ev.get("span_id")
+        if span_id:
+            if name == "msg_send":
+                sends[span_id] = base
+            elif name == "msg_deliver":
+                delivers[span_id] = base
+
+    # flow arrows: one per message observed on BOTH sides
+    for span_id, send in sends.items():
+        recv = delivers.get(span_id)
+        if recv is None:
+            continue
+        fid = _flow_id(span_id)
+        common = {"name": "msg", "cat": "msg_flow", "id": fid}
+        trace_events.append({
+            **common, "ph": "s", "pid": send["pid"], "tid": send["tid"],
+            "ts": send["ts"],
+        })
+        trace_events.append({
+            **common, "ph": "f", "bp": "e", "pid": recv["pid"],
+            "tid": recv["tid"],
+            # a deliver observed at (or clock-skewed before) its send
+            # still needs flow ts >= the start or the arrow is dropped
+            "ts": max(recv["ts"], send["ts"] + 1.0),
+        })
+
+    for r in sorted(ranks):
+        label = f"rank {r}" + (" (server)" if r == 0 else "")
+        trace_events.append({
+            "ph": "M", "name": "process_name", "pid": r, "tid": 0,
+            "args": {"name": label},
+        })
+        trace_events.append({
+            "ph": "M", "name": "process_sort_index", "pid": r, "tid": 0,
+            "args": {"sort_index": r},
+        })
+
+    return {"traceEvents": trace_events, "displayTimeUnit": "ms"}
+
+
+def resolve_inputs(inputs: list[str]) -> list[str]:
+    paths: list[str] = []
+    for inp in inputs:
+        if os.path.isdir(inp):
+            found = sorted(glob.glob(os.path.join(inp, "trace_rank*.json")))
+            if not found:
+                raise SystemExit(f"no trace_rank*.json dumps in {inp!r}")
+            paths.extend(found)
+        else:
+            paths.append(inp)
+    return paths
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="merge per-rank tracer dumps into one Perfetto-"
+                    "loadable Chrome trace (pid = rank)"
+    )
+    p.add_argument("inputs", nargs="+",
+                   help="telemetry dir(s) and/or trace_rank*.json files")
+    p.add_argument("--out", default=None,
+                   help="output path (default: merged_trace.json next to "
+                        "the first input)")
+    a = p.parse_args(argv)
+    paths = resolve_inputs(a.inputs)
+    merged = merge(paths)
+    out = a.out
+    if out is None:
+        anchor = a.inputs[0]
+        base = anchor if os.path.isdir(anchor) else os.path.dirname(anchor)
+        out = os.path.join(base or ".", "merged_trace.json")
+    with open(out, "w") as f:
+        json.dump(merged, f)
+    n = len(merged["traceEvents"])
+    print(f"wrote {out}: {n} trace events from {len(paths)} dump(s)",
+          file=sys.stderr)
+    print(out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -157,14 +157,50 @@ def _fedavg_sim_final(cfg_d):
 
 def test_cross_process_fedavg_grpc_matches_sim(tmp_path):
     """CI mini-run (2 OS processes, server + 1 client over gRPC on
-    localhost): final global weights == compiled sim to round-off."""
+    localhost): final global weights == compiled sim to round-off.
+    Runs with --telemetry_dir, which must not perturb the math AND must
+    produce per-rank span dumps that scripts/merge_trace.py folds into
+    one Chrome trace where a server->client message's send and deliver
+    share a trace id, plus nonzero transport counters
+    (docs/OBSERVABILITY.md acceptance pin)."""
+    tdir = tmp_path / "telemetry"
     cfg_d = _cfg_dict(tmp_path, "fedavg", num_clients=1, rounds=2)
-    summary = _spawn_world(tmp_path, cfg_d, world=2, backend="grpc")
+    summary = _spawn_world(tmp_path, cfg_d, world=2, backend="grpc",
+                           extra=("--telemetry_dir", str(tdir)))
     assert summary["rounds"] == 2
     with open(summary["final_params"], "rb") as f:
         got = pickle.load(f)
     _assert_close(got, _fedavg_sim_final(cfg_d))
     assert 0.0 <= summary["acc"] <= 1.0  # server-side global eval ran
+
+    # per-rank artifacts from both OS processes
+    for r in (0, 1):
+        assert (tdir / f"trace_rank{r}.json").exists()
+        metrics = json.loads((tdir / f"metrics_rank{r}.json").read_text())
+        c = metrics["counters"]
+        assert c["transport.messages_sent"] > 0
+        assert c["transport.bytes_sent"] > 0
+        assert c["transport.bytes_received"] > 0
+    out = tdir / "merged.json"
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "merge_trace.py"),
+         str(tdir), "--out", str(out)],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert res.returncode == 0, res.stderr
+    merged = json.loads(out.read_text())
+    evs = merged["traceEvents"]
+    pids = {e["pid"] for e in evs if e.get("ph") != "M"}
+    assert {0, 1} <= pids
+    sends = {e["args"]["span_id"]: e for e in evs
+             if e.get("name") == "msg_send" and e["pid"] == 0}
+    delivers = {e["args"]["span_id"]: e for e in evs
+                if e.get("name") == "msg_deliver" and e["pid"] == 1}
+    shared = [
+        s for s in sends if s in delivers
+        and sends[s]["args"]["trace_id"] == delivers[s]["args"]["trace_id"]
+    ]
+    assert shared, "no server->client send/deliver pair shares a trace id"
 
 
 @pytest.mark.slow

@@ -566,6 +566,7 @@ def test_deploy_client_process_killed_mid_run(tmp_path):
     ip_path.write_text(json.dumps(
         {str(r): ["127.0.0.1", ports[r]] for r in range(3)}
     ))
+    telemetry_dir = tmp_path / "telemetry"
     # heartbeat_timeout must tolerate CPU starvation on a loaded 1-core
     # CI host (three jax processes compiling at once): the timeout only
     # guards against FALSE positives here — the killed client is caught
@@ -575,6 +576,7 @@ def test_deploy_client_process_killed_mid_run(tmp_path):
             "--config", str(cfg_path), "--backend", "grpc",
             "--world_size", "3", "--ip_config", str(ip_path),
             "--ready_timeout", "60",
+            "--telemetry_dir", str(telemetry_dir),
             "--heartbeat_interval", "0.5", "--heartbeat_timeout", "12",
             "--quorum_fraction", "0.5", "--round_deadline", "30"]
     env = _subproc_env()
@@ -613,3 +615,12 @@ def test_deploy_client_process_killed_mid_run(tmp_path):
     # with the injected exit code (never unwound, like a real kill -9)
     assert c1.returncode == 0, out1
     assert c2.returncode == CHAOS_EXIT_CODE, out2
+    # flight-recorder acceptance pin (docs/OBSERVABILITY.md): the dead
+    # peer left a debuggable artifact on the server naming rank 2
+    dumps = [f for f in telemetry_dir.iterdir()
+             if f.name.startswith("flight_rank0")
+             and "dead_peer" in f.name]
+    assert dumps, sorted(p.name for p in telemetry_dir.iterdir())
+    flight = json.loads(dumps[0].read_text())
+    assert flight["peer"] == 2
+    assert "metrics" in flight and "events" in flight

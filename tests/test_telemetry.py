@@ -1,0 +1,377 @@
+"""Telemetry suite: metrics registry, trace propagation + merge,
+heartbeat RTT, flight recorder, and the sink/tracer satellites
+(docs/OBSERVABILITY.md).
+
+The pins, in dependency order:
+
+1. MetricsRegistry arithmetic is thread-safe and snapshot-stable;
+2. Tracer.span records the span EVEN when the body raises (tagged with
+   the error) — a failing round must leave its timing behind;
+3. MetricsSink.close() materializes summary.json and log() survives
+   non-float-coercible values (repr fallback);
+4. transport counters: loopback sends/receives count messages + bytes,
+   and under seeded chaos the drop/dup pattern is deterministic per
+   seed (same seed -> same counters, different seed -> different);
+5. retry attempts/exhaustions land in the registry;
+6. the heartbeat ping/echo loop updates a per-peer RTT gauge;
+7. an actor world with tracing on yields per-rank span dumps that
+   scripts/merge_trace.py folds into valid Chrome trace JSON with both
+   ranks' pids and a cross-rank send/deliver pair sharing a trace id;
+8. a quorum-lost abort dumps a flight artifact naming the dead peers.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from fedml_tpu.core import telemetry
+from fedml_tpu.core.manager import Manager
+from fedml_tpu.core.message import Message
+from fedml_tpu.core.telemetry import MetricsRegistry
+from fedml_tpu.core.tracing import Tracer
+from fedml_tpu.core.transport.chaos import ChaosTransport, FaultPolicy
+from fedml_tpu.core.transport.loopback import LoopbackHub
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def telemetry_env(tmp_path):
+    """Enable the process telemetry plane into a tmp dir; restore the
+    all-disabled default afterwards (other suites assume it off)."""
+    telemetry.configure(telemetry_dir=str(tmp_path / "telemetry"), rank=0)
+    yield str(tmp_path / "telemetry")
+    telemetry.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# registry unit
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_registry_counts_gauges_histograms_threadsafe():
+    reg = MetricsRegistry()
+
+    def work():
+        for _ in range(1000):
+            reg.inc("c")
+            reg.inc("bytes", 10)
+            reg.observe("lat", 0.5)
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    reg.gauge("depth", 3)
+    snap = reg.snapshot()
+    assert snap["counters"]["c"] == 8000
+    assert snap["counters"]["bytes"] == 80000
+    assert snap["gauges"]["depth"] == 3.0
+    h = snap["histograms"]["lat"]
+    assert h["count"] == 8000 and h["min"] == h["max"] == 0.5
+    assert sum(h["buckets"].values()) == 8000
+    # snapshot is a copy: mutating it must not leak back
+    snap["counters"]["c"] = -1
+    assert reg.snapshot()["counters"]["c"] == 8000
+    reg.reset()
+    assert reg.snapshot() == {"counters": {}, "gauges": {},
+                              "histograms": {}}
+
+
+def test_metrics_registry_disabled_is_inert():
+    reg = MetricsRegistry(enabled=False)
+    reg.inc("c")
+    reg.gauge("g", 1)
+    reg.observe("h", 1)
+    assert reg.snapshot() == {"counters": {}, "gauges": {},
+                              "histograms": {}}
+
+
+# ---------------------------------------------------------------------------
+# tracer satellites
+# ---------------------------------------------------------------------------
+
+
+def test_tracer_span_survives_raising_body():
+    tr = Tracer(rank=3)
+    with pytest.raises(ValueError):
+        with tr.span("failing_round", round=7):
+            raise ValueError("boom")
+    assert len(tr.events) == 1
+    ev = tr.events[0]
+    assert ev["name"] == "failing_round" and ev["round"] == 7
+    assert "boom" in ev["error"]
+    assert ev["rank"] == 3 and ev["seconds"] >= 0 and ev["ts"] > 0
+    # and a healthy span carries no error key
+    with tr.span("ok"):
+        pass
+    assert "error" not in tr.events[1]
+
+
+def test_tracer_dump_shape_and_events(tmp_path):
+    tr = Tracer(rank=1)
+    tr.event("msg_send", trace_id="t", span_id="s", receiver=0)
+    with tr.span("work"):
+        pass
+    path = tmp_path / "trace.json"
+    tr.dump(str(path))
+    data = json.loads(path.read_text())
+    assert data["rank"] == 1
+    kinds = [e["kind"] for e in data["events"]]
+    assert kinds == ["event", "span"]
+
+
+# ---------------------------------------------------------------------------
+# sink satellites
+# ---------------------------------------------------------------------------
+
+
+def test_sink_writes_summary_json_and_repr_fallback(tmp_path):
+    from fedml_tpu.metrics.sink import MetricsSink
+
+    class Weird:
+        def __repr__(self):
+            return "<weird object>"
+
+    sink = MetricsSink(path=str(tmp_path / "m" / "metrics.jsonl"))
+    sink.log({"acc": 0.5, "weird": Weird()})  # must not raise
+    sink.close()
+    lines = (tmp_path / "m" / "metrics.jsonl").read_text().splitlines()
+    assert json.loads(lines[0])["weird"] == "<weird object>"
+    summary = json.loads((tmp_path / "m" / "summary.json").read_text())
+    assert summary["acc"] == 0.5
+    assert summary["weird"] == "<weird object>"
+
+
+# ---------------------------------------------------------------------------
+# transport counters (loopback + chaos determinism + retry)
+# ---------------------------------------------------------------------------
+
+
+def test_loopback_counts_messages_and_bytes(telemetry_env):
+    hub = LoopbackHub()
+    a, b = hub.create(0), hub.create(1)
+    for i in range(5):
+        a.send_message(Message(100, 0, 1, {"i": i}))
+    c = telemetry.METRICS.snapshot()["counters"]
+    assert c["transport.messages_sent"] == 5
+    assert c["transport.messages_received"] == 5
+    assert c["transport.bytes_sent"] == c["transport.bytes_received"] > 0
+    assert b._inbox.qsize() == 5
+
+
+def _chaos_counter_run(seed: int) -> dict:
+    """One seeded chaos burst over loopback; returns the counter delta.
+    Drop/dup only — no delay/reorder timers, so every counter has
+    settled the moment the sends return and the run is exactly
+    replayable."""
+    telemetry.METRICS.reset()
+    hub = LoopbackHub()
+    a = ChaosTransport(
+        hub.create(0),
+        FaultPolicy(seed=seed, drop_prob=0.25, dup_prob=0.2),
+    )
+    hub.create(1)
+    for i in range(200):
+        a.send_message(Message(100, 0, 1, {"i": i}))
+    return telemetry.METRICS.snapshot()["counters"]
+
+
+def test_chaos_transport_counters_deterministic_per_seed(telemetry_env):
+    c1 = _chaos_counter_run(seed=7)
+    c2 = _chaos_counter_run(seed=7)
+    assert c1 == c2
+    assert c1["chaos.dropped"] > 0 and c1["chaos.duplicated"] > 0
+    assert c1["transport.bytes_sent"] > 0
+    # every chaos-surviving send hit the wire exactly once
+    assert c1["transport.messages_sent"] == c1["chaos.sent"]
+    assert (c1["transport.messages_sent"]
+            == 200 - c1["chaos.dropped"] + c1["chaos.duplicated"])
+    c3 = _chaos_counter_run(seed=8)
+    assert c3["chaos.dropped"] != c1["chaos.dropped"] or (
+        c3["transport.bytes_sent"] != c1["transport.bytes_sent"]
+    )
+
+
+def test_retry_counters_land_in_registry(telemetry_env):
+    from fedml_tpu.core.transport.retry import (
+        RetryExhausted, RetryPolicy, call_with_retry,
+    )
+
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    policy = RetryPolicy(max_attempts=5, base_delay_s=0.001, deadline_s=5)
+    assert call_with_retry(flaky, policy=policy) == "ok"
+    c = telemetry.METRICS.snapshot()["counters"]
+    assert c["transport.retry_attempts"] == 2
+    assert "transport.retry_exhausted" not in c
+    with pytest.raises(RetryExhausted):
+        call_with_retry(
+            lambda: (_ for _ in ()).throw(OSError("down")),
+            policy=RetryPolicy(max_attempts=2, base_delay_s=0.001,
+                               deadline_s=1),
+        )
+    c = telemetry.METRICS.snapshot()["counters"]
+    assert c["transport.retry_exhausted"] == 1
+
+
+# ---------------------------------------------------------------------------
+# heartbeat RTT gauge
+# ---------------------------------------------------------------------------
+
+
+def test_heartbeat_rtt_gauge_updates(telemetry_env):
+    hub = LoopbackHub()
+    a = Manager(0, 2, hub.create(0))
+    b = Manager(1, 2, hub.create(1))
+    ta = threading.Thread(target=a.run, daemon=True)
+    tb = threading.Thread(target=b.run, daemon=True)
+    ta.start(); tb.start()
+    a.enable_liveness([1], interval_s=0.05, timeout_s=30.0)
+    deadline = time.monotonic() + 5
+    key = "manager.heartbeat_rtt_s.peer1"
+    rtt = None
+    while time.monotonic() < deadline:
+        rtt = telemetry.METRICS.snapshot()["gauges"].get(key)
+        if rtt is not None:
+            break
+        time.sleep(0.02)
+    assert rtt is not None, "RTT gauge never updated"
+    assert 0.0 <= rtt < 5.0
+    a.finish(); b.finish()
+    ta.join(timeout=2); tb.join(timeout=2)
+
+
+# ---------------------------------------------------------------------------
+# trace propagation + merge (actor world over loopback)
+# ---------------------------------------------------------------------------
+
+
+def test_actor_world_trace_merges_into_chrome_json(telemetry_env,
+                                                   tmp_path):
+    from tests.test_fault_tolerance import (
+        WORLD, _cfg, _make_world_transports, _run_world,
+    )
+
+    server, history = _run_world(_make_world_transports("loopback"),
+                                 _cfg(rounds=2))
+    assert server.done.is_set()
+    telemetry.flush()
+    dump = os.path.join(telemetry_env, "trace_rank0.json")
+    assert os.path.exists(dump)
+    out = tmp_path / "merged.json"
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "merge_trace.py"),
+         telemetry_env, "--out", str(out)],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert res.returncode == 0, res.stderr
+    merged = json.loads(out.read_text())
+    evs = merged["traceEvents"]
+    pids = {e["pid"] for e in evs if e.get("ph") != "M"}
+    # a shared-process world still tags every event with its actor's
+    # rank, so all three ranks appear as Perfetto processes
+    assert {0, 1, 2} <= pids
+    sends = {e["args"]["span_id"]: e for e in evs
+             if e.get("name") == "msg_send"}
+    delivers = {e["args"]["span_id"]: e for e in evs
+                if e.get("name") == "msg_deliver"}
+    linked = [
+        s for s in sends
+        if s in delivers and sends[s]["pid"] != delivers[s]["pid"]
+        and sends[s]["args"]["trace_id"] == delivers[s]["args"]["trace_id"]
+    ]
+    assert linked, "no cross-rank send/deliver pair shares a trace id"
+    # rounds left their timing spans, and flow arrows were emitted
+    assert any(e.get("cat") == "round" for e in evs)
+    assert any(e.get("cat") == "msg_flow" for e in evs)
+    # client compute is visible as handler/local_update spans
+    assert any(e.get("name") == "local_update" for e in evs)
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_flight_dump_on_quorum_lost_names_dead_peers(telemetry_env):
+    from fedml_tpu.algorithms.distributed_fedavg import RoundPolicy
+    from tests.test_fault_tolerance import (
+        _cfg, _make_world_transports, _run_world,
+    )
+
+    server, history = _run_world(
+        _make_world_transports("loopback"),
+        _cfg(rounds=3),
+        policies={1: FaultPolicy(crash_at_round=0),
+                  2: FaultPolicy(crash_at_round=0)},
+        round_policy=RoundPolicy(quorum_fraction=1.0,
+                                 round_deadline_s=1.5),
+    )
+    assert server.failure is not None
+    dumps = [f for f in os.listdir(telemetry_env)
+             if f.startswith("flight_") and "quorum_lost" in f]
+    assert dumps, os.listdir(telemetry_env)
+    data = json.loads(
+        open(os.path.join(telemetry_env, dumps[0])).read()
+    )
+    assert data["reason"] == "quorum_lost"
+    assert "deadline" in data["detail"] and "quorum" in data["detail"]
+    assert data["dead_peers"] == sorted(server.dead_peers)
+    assert "metrics" in data and "events" in data
+    c = data["metrics"]["counters"]
+    assert c.get("round.quorum_lost_aborts", 0) >= 1
+
+
+def test_flight_recorder_ring_is_bounded_and_dump_numbered(tmp_path):
+    from fedml_tpu.core.telemetry import FlightRecorder
+
+    rec = FlightRecorder(capacity=4, enabled=True)
+    rec.dir = str(tmp_path)
+    for i in range(10):
+        rec.record("tick", i=i)
+    p1 = rec.dump("dead_peer", peer=2)
+    p2 = rec.dump("dead_peer", peer=3)
+    assert p1 != p2 and os.path.exists(p1) and os.path.exists(p2)
+    d1 = json.loads(open(p1).read())
+    assert d1["peer"] == 2
+    # bounded ring: only the most recent events survive (+ the trigger)
+    ticks = [e for e in d1["events"] if e["kind"] == "tick"]
+    assert len(ticks) <= 4
+    assert ticks[-1]["i"] == 9
+
+
+def test_crash_excepthook_dumps_flight(tmp_path):
+    """An unhandled crash in a --telemetry_dir run leaves a flight
+    artifact (sys.excepthook path, exercised in a real subprocess)."""
+    tdir = tmp_path / "telemetry"
+    code = (
+        "from fedml_tpu.core import telemetry\n"
+        f"telemetry.configure(telemetry_dir={str(tdir)!r}, rank=5)\n"
+        "telemetry.RECORDER.record('step', n=1)\n"
+        "raise RuntimeError('kaboom')\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get(
+                   "PYTHONPATH", ""))
+    res = subprocess.run([sys.executable, "-c", code], env=env, cwd=REPO,
+                         capture_output=True, text=True, timeout=60)
+    assert res.returncode != 0 and "kaboom" in res.stderr
+    dumps = [f for f in os.listdir(tdir)
+             if f.startswith("flight_rank5") and "crash" in f]
+    assert dumps, list(os.listdir(tdir))
+    data = json.loads(open(tdir / dumps[0]).read())
+    assert "kaboom" in data["error"]
+    # the exit flush also materialized the metrics snapshot
+    assert (tdir / "metrics_rank5.json").exists()
