@@ -90,6 +90,13 @@ print(f"telemetry smoke ok: {len(evs)} events, "
       f"{len(linked)} cross-rank message flows")
 EOF
 
+echo "== recovery smoke: SIGKILL server -> relaunch -> resume =="
+# a 2-rank gRPC deployment with --checkpoint_every 1 is SIGKILLed
+# mid-run and relaunched; the relaunched server must report
+# resumed_from > 0 and finish all rounds (docs/FAULT_TOLERANCE.md
+# "Recovery")
+JAX_PLATFORMS=cpu python scripts/kill_resume_smoke.py "$OUT/kill_resume"
+
 echo "== 2/3 smoke matrix (tiny runs) =="
 # one process for the whole matrix: same CLI argv surface via
 # run.main(argv), but jax/backend startup and compile caches paid once

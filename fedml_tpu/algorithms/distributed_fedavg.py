@@ -32,8 +32,10 @@ from fedml_tpu.core.message import (
     KEY_MODEL_PARAMS,
     KEY_NUM_SAMPLES,
     KEY_ROUND,
+    MSG_TYPE_C2S_JOIN,
     MSG_TYPE_C2S_RESULT,
     MSG_TYPE_S2C_SYNC_MODEL,
+    MSG_TYPE_S2C_WELCOME,
     Message,
 )
 from fedml_tpu.core.transport.base import BaseTransport
@@ -66,10 +68,18 @@ class RoundPolicy:
       quorum, the run aborts with a diagnostic instead of hanging.
       ``None`` disables the deadline (crashed peers are still handled
       via the heartbeat dead-peer callback).
+    - ``recovery_extensions``: how many times a deadline that fires
+      UNDER quorum re-arms for the same round instead of aborting —
+      under a supervisor a crashed rank is typically seconds from being
+      restarted and rejoining, so the hard quorum-lost abort only fires
+      once recovery has had its chance (docs/FAULT_TOLERANCE.md
+      "Recovery"). 0 (the default) keeps the PR-1 abort-at-first-expiry
+      behavior.
     """
 
     quorum_fraction: float = 1.0
     round_deadline_s: float | None = None
+    recovery_extensions: int = 0
 
     def __post_init__(self):
         if not (0.0 < self.quorum_fraction <= 1.0):
@@ -82,11 +92,37 @@ class RoundPolicy:
                 f"round_deadline_s must be positive or None, "
                 f"got {self.round_deadline_s}"
             )
+        if self.recovery_extensions < 0:
+            raise ValueError(
+                f"recovery_extensions must be >= 0, "
+                f"got {self.recovery_extensions}"
+            )
+        if self.recovery_extensions and self.round_deadline_s is None:
+            raise ValueError(
+                "recovery_extensions requires round_deadline_s: "
+                "extensions re-arm the round deadline, so without one "
+                "there is nothing to extend and the quorum-lost abort "
+                "would still fire immediately"
+            )
 
 
 class QuorumLostError(RuntimeError):
     """The server could not assemble a quorum of client results (too many
     crashed/straggling ranks). Carries the server's diagnostic."""
+
+
+def _result_is_finite(params, n_k: float) -> bool:
+    """True iff a client result carries only finite values (floating
+    leaves checked; integer leaves are finite by construction)."""
+    if not math.isfinite(n_k):
+        return False
+    for leaf in jax.tree.leaves(params):
+        a = np.asarray(leaf)
+        if np.issubdtype(a.dtype, np.floating) and not np.all(
+            np.isfinite(a)
+        ):
+            return False
+    return True
 
 
 class FedAvgServerActor(ServerManager):
@@ -109,6 +145,8 @@ class FedAvgServerActor(ServerManager):
         batch_size: int | None = None,
         data: FederatedData | None = None,
         round_policy: RoundPolicy | None = None,
+        checkpointer=None,
+        checkpoint_every: int = 1,
     ):
         super().__init__(0, size, transport)
         self.cfg = cfg
@@ -176,8 +214,63 @@ class FedAvgServerActor(ServerManager):
         self.dead_peers: set[int] = set()
         self.failure: str | None = None  # quorum-lost diagnostic
         self._deadline_timer: threading.Timer | None = None
+        # generation stamp carried by every armed deadline timer:
+        # Timer.cancel() is a no-op once the callback has STARTED (it
+        # may already be blocked on self._lock), so a superseded timer
+        # is also invalidated by its stale generation — without this, a
+        # timer racing the recovery-extension re-arm could abort (or
+        # burn an extra extension) inside the freshly-opened window
+        self._deadline_gen = 0
+        # deadline-under-quorum re-arms already spent on the current
+        # round (RoundPolicy.recovery_extensions); reset per round
+        self._extensions_used = 0
+        # the CURRENT round's broadcast payload ``(round_idx, host_vars,
+        # cohort)``, stashed by start_round so a mid-round rejoiner gets
+        # the EXACT sync its cohort-mates got (a WELCOME built from live
+        # state could race a round close and ship the next round's model
+        # under this round's tag)
+        self._round_sync: tuple[int, dict, np.ndarray] | None = None
+        # rank -> round of its last WELCOME: a rejoiner re-announces
+        # JOIN every 0.5 s until its first inbound, and with a large
+        # model the WELCOME can take longer than that — the duplicates
+        # must refresh its watchdog, not re-serialize the full model
+        # (or re-count the rejoin)
+        self._welcomed: dict[int, int] = {}
+        # -- durable rounds (docs/FAULT_TOLERANCE.md "Recovery"): with a
+        # RoundCheckpointer the server persists ServerState (which
+        # carries the round counter the RNG folding derives from) every
+        # ``checkpoint_every`` closed rounds, and a restarted rank 0
+        # resumes from the last completed round instead of round 0.
+        self._ckpt = checkpointer
+        self.checkpoint_every = checkpoint_every
+        self.resumed_from = 0
+        if checkpointer is not None:
+            if checkpoint_every < 1:
+                raise ValueError(
+                    f"checkpoint_every must be >= 1 with a checkpointer, "
+                    f"got {checkpoint_every}"
+                )
+            self.state, start = checkpointer.restore_or(self.state)
+            if start:
+                if int(self.state.round) != start:
+                    raise ValueError(
+                        f"checkpoint at step {start - 1} carries "
+                        f"round={int(self.state.round)}; expected "
+                        f"{start} — wrong run directory?"
+                    )
+                self.round_idx = start
+                self.resumed_from = start
+                telemetry.METRICS.inc("recovery.resumes")
+                telemetry.METRICS.gauge("recovery.resumed_from_round",
+                                        start)
+                telemetry.RECORDER.record("resume", round=start)
         self.register_message_receive_handler(
             MSG_TYPE_C2S_RESULT, self._handle_result
+        )
+        # library-path rejoin entry; the deployment barrier re-registers
+        # this type with its pre-kickoff-aware wrapper (deploy.py)
+        self.register_message_receive_handler(
+            MSG_TYPE_C2S_JOIN, lambda msg: self.on_peer_rejoin(msg.sender)
         )
 
     @property
@@ -210,7 +303,49 @@ class FedAvgServerActor(ServerManager):
         live = len(self._live_workers())
         return max(1, math.ceil(self.round_policy.quorum_fraction * live))
 
+    def kickoff(self) -> None:
+        """Deployment-barrier entry: start the (possibly resumed) run
+        unless a round is already underway. After a server restart the
+        barrier can complete on the very message that closed the
+        resumed round (the Manager's handler runs before the barrier
+        observer), whose ``_close_round`` already started the next one
+        — a second ``start_round`` here would re-broadcast it and make
+        every client compute the round twice."""
+        with self._lock:
+            sync = self._round_sync
+            if sync is not None and sync[0] == self.round_idx:
+                return  # a round is already in flight
+        self.start_round()
+
     def start_round(self) -> None:
+        # a server restored from its FINAL checkpoint has nothing left
+        # to run — finish immediately instead of broadcasting a sync
+        # for a round past the end
+        if self.round_idx >= self.cfg.fed.num_rounds:
+            self.done.set()
+            self.finish_all()
+            return
+        # reconcile rejoin/death races at the round boundary: a rank in
+        # dead_peers that the liveness monitor does NOT consider dead
+        # was revived by a JOIN that interleaved with an in-flight
+        # death callback (the callback re-added it after the rejoin's
+        # removal, stranding a live rank outside the cohort forever).
+        # The monitor is the live-ness source of truth — a truly-down
+        # peer lands (back) in monitor.dead within a heartbeat timeout,
+        # so healing here converges instead of flapping.
+        mon = self.liveness
+        if mon is not None:
+            mon_dead = mon.dead_snapshot()
+            with self._lock:
+                stranded = sorted(self.dead_peers - mon_dead)
+                self.dead_peers -= set(stranded)
+            if stranded:
+                telemetry.METRICS.inc("recovery.rejoins_reconciled",
+                                      len(stranded))
+                telemetry.RECORDER.record(
+                    "rejoin_reconciled", peers=stranded,
+                    round=self.round_idx,
+                )
         cohort = self._sample()
         self._round_t0 = time.monotonic()
         tr = telemetry.TRACER
@@ -222,6 +357,12 @@ class FedAvgServerActor(ServerManager):
         host_vars = jax.tree.map(np.asarray, self.variables)
         with self._lock:
             ranks = self._live_workers()
+            self._extensions_used = 0
+            self._deadline_gen += 1
+            gen = self._deadline_gen
+            # one consistent (round, model, cohort) snapshot: WELCOME
+            # replies to mid-round rejoiners replay exactly this sync
+            self._round_sync = (self.round_idx, host_vars, cohort)
         self.broadcast(
             MSG_TYPE_S2C_SYNC_MODEL,
             lambda r: {
@@ -236,7 +377,7 @@ class FedAvgServerActor(ServerManager):
             t = threading.Timer(
                 self.round_policy.round_deadline_s,
                 self._on_round_deadline,
-                args=(self.round_idx,),
+                args=(self.round_idx, gen),
             )
             t.daemon = True
             self._deadline_timer = t
@@ -246,6 +387,68 @@ class FedAvgServerActor(ServerManager):
         """A model sync that cannot be shipped == a crashed worker; the
         round proceeds without it rather than aborting the broadcast."""
         self.on_peer_dead(rank)
+
+    def on_peer_rejoin(self, rank: int) -> None:
+        """Rejoin entry (``MSG_TYPE_C2S_JOIN`` mid-run, docs/
+        FAULT_TOLERANCE.md "Recovery"): reverse the dead-peer removal,
+        re-arm the rank's liveness watchdog, and reply ``WELCOME`` with
+        the CURRENT round's sync payload — the same (model, round,
+        client assignment) its cohort-mates received, so a rejoiner's
+        result is byte-identical to the one the original sync would
+        have produced. Safe from any thread; a duplicate JOIN from an
+        already-live rank only refreshes its watchdog (the duplicate
+        result its WELCOME provokes is discarded by the keep-first
+        dedup)."""
+        with self._lock:
+            if self.done.is_set() or self.failure is not None:
+                return
+            was_dead = rank in self.dead_peers
+            self.dead_peers.discard(rank)
+            sync = self._round_sync
+            if sync is not None and sync[0] != self.round_idx:
+                # the snapshot's round is mid-close (round_idx already
+                # advanced): a WELCOME for it would only provoke a
+                # local update whose result is guaranteed stale —
+                # skip; the rank is live again, so the imminent
+                # start_round broadcast covers it
+                sync = None
+            if sync is not None:
+                if not was_dead and self._welcomed.get(rank) == sync[0]:
+                    # duplicate announce (the WELCOME is still in
+                    # flight): refresh the watchdog, send nothing
+                    sync = None
+                    duplicate = True
+                else:
+                    self._welcomed[rank] = sync[0]
+                    duplicate = False
+            else:
+                duplicate = not was_dead
+        if self.liveness is not None:
+            self.liveness.revive(rank)
+        if duplicate:
+            return
+        telemetry.METRICS.inc("recovery.rejoins")
+        telemetry.RECORDER.record("rejoin", peer=rank, was_dead=was_dead)
+        if sync is None:
+            return  # no round underway; the next broadcast covers it
+        round_idx, host_vars, cohort = sync
+        try:
+            self.send_message(
+                Message(
+                    MSG_TYPE_S2C_WELCOME,
+                    self.rank,
+                    rank,
+                    {
+                        KEY_MODEL_PARAMS: host_vars,
+                        KEY_CLIENT_INDEX: int(
+                            cohort[(rank - 1) % len(cohort)]
+                        ),
+                        KEY_ROUND: round_idx,
+                    },
+                )
+            )
+        except Exception:
+            self.on_peer_dead(rank)  # flapped again mid-welcome
 
     def on_peer_dead(self, rank: int) -> None:
         """Dead-peer callback (heartbeat monitor / failed sends). Safe to
@@ -265,9 +468,10 @@ class FedAvgServerActor(ServerManager):
         )
         self._maybe_close_round(deadline_fired=False)
 
-    def _on_round_deadline(self, round_idx: int) -> None:
+    def _on_round_deadline(self, round_idx: int, gen: int) -> None:
         self._maybe_close_round(deadline_fired=True,
-                                deadline_round=round_idx)
+                                deadline_round=round_idx,
+                                deadline_gen=gen)
 
     def _abort_locked(self, why: str) -> None:
         """Record the abort decision. Must run under ``self._lock`` so a
@@ -277,7 +481,10 @@ class FedAvgServerActor(ServerManager):
         self.failure = why
 
     def _maybe_close_round(
-        self, deadline_fired: bool, deadline_round: int | None = None
+        self,
+        deadline_fired: bool,
+        deadline_round: int | None = None,
+        deadline_gen: int | None = None,
     ) -> None:
         """Close the round if its exit condition holds: every live worker
         reported (zero-fault path — byte-identical to the strict
@@ -289,44 +496,95 @@ class FedAvgServerActor(ServerManager):
         deadline timer carries its own round (``deadline_round``) and is
         re-validated under that lock, so a timer firing just as its round
         closes cannot apply deadline semantics to the NEXT round."""
+        extended = None
         with self._lock:
             if self.done.is_set() or self.failure is not None:
                 return
             if deadline_round is not None and (
                 deadline_round != self.round_idx
+                or (deadline_gen is not None
+                    and deadline_gen != self._deadline_gen)
             ):
-                return  # stale timer: its round already closed
+                # stale timer: its round already closed, or a recovery
+                # extension superseded it (cancel() cannot stop a timer
+                # whose callback is already blocked on this lock)
+                return
             live = self._live_workers()
             n_results = len(self._results)
             quorum = self._quorum()
             abort = results = None
             closed_idx = self.round_idx
             dead = sorted(self.dead_peers)  # snapshot under the lock
-            if not live:
-                abort = (
-                    f"all {self.size - 1} workers died before round "
-                    f"{self.round_idx} closed"
-                )
-            elif n_results >= len(live) or (
+            if live and (n_results >= len(live) or (
                 deadline_fired and n_results >= quorum
-            ):
+            )):
                 results, self._results = self._results, {}
                 self.round_idx += 1
                 if self._deadline_timer is not None:
                     self._deadline_timer.cancel()
                     self._deadline_timer = None
-            elif deadline_fired:
-                abort = (
-                    f"round {self.round_idx} deadline "
-                    f"({self.round_policy.round_deadline_s}s) expired "
-                    f"with {n_results}/{len(live)} live results "
-                    f"(quorum {quorum}; dead peers "
-                    f"{sorted(self.dead_peers)})"
-                )
+            elif deadline_fired or not live:
+                # under quorum (or out of workers entirely): abort only
+                # after recovery is exhausted — each extension re-arms
+                # the deadline so a supervised restart can rejoin and
+                # deliver the missing results
+                if (self._extensions_used
+                        < self.round_policy.recovery_extensions
+                        and self.round_policy.round_deadline_s
+                        is not None):
+                    self._extensions_used += 1
+                    extended = self._extensions_used
+                    # supersede the timer already covering this round
+                    # (the all-dead path gets here with the ORIGINAL
+                    # deadline timer still armed — left valid it would
+                    # fire at the unextended time, see extensions
+                    # exhausted, and abort inside the window the
+                    # extension opened). cancel() handles the not-yet-
+                    # fired case; the generation bump invalidates a
+                    # timer already blocked on this lock.
+                    if self._deadline_timer is not None:
+                        self._deadline_timer.cancel()
+                    self._deadline_gen += 1
+                    t = threading.Timer(
+                        self.round_policy.round_deadline_s,
+                        self._on_round_deadline,
+                        args=(self.round_idx, self._deadline_gen),
+                    )
+                    t.daemon = True
+                    self._deadline_timer = t
+                    t.start()
+                elif not live:
+                    spent = (
+                        f" ({self._extensions_used} recovery "
+                        f"extensions spent)"
+                        if self.round_policy.recovery_extensions
+                        else ""
+                    )
+                    abort = (
+                        f"all {self.size - 1} workers died before "
+                        f"round {self.round_idx} closed{spent}"
+                    )
+                else:
+                    abort = (
+                        f"round {self.round_idx} deadline "
+                        f"({self.round_policy.round_deadline_s}s) "
+                        f"expired with {n_results}/{len(live)} live "
+                        f"results (quorum {quorum}; dead peers "
+                        f"{sorted(self.dead_peers)}; "
+                        f"{self._extensions_used} recovery extensions "
+                        f"spent)"
+                    )
             else:
                 return  # stragglers may still arrive before the deadline
             if abort is not None:
                 self._abort_locked(abort)
+        if extended is not None:
+            telemetry.METRICS.inc("recovery.deadline_extensions")
+            telemetry.RECORDER.record(
+                "deadline_extended", round=closed_idx,
+                extension=extended, results=n_results, quorum=quorum,
+            )
+            return
         if abort is not None:
             # a quorum-lost abort is a flight-recorder trigger: PR 1
             # made it loud, this makes it debuggable
@@ -340,22 +598,55 @@ class FedAvgServerActor(ServerManager):
             self._close_round(results, closed_idx, n_live=len(live),
                               dead=dead)
 
+    def _discard_locked(self, msg: Message) -> bool:
+        """Cheap drop checks, under ``self._lock``: finished/aborted
+        run, stale round tag (a straggler's result from an already-
+        closed round must not leak into the current aggregate; untagged
+        results predate round-tagging and are accepted for
+        compatibility), dead sender, and duplicate ``(round, rank)``
+        results — chaos dup / retry resend / rejoin recompute — where
+        the FIRST is kept so sample mass is never double-counted in the
+        renormalized survivor aggregation."""
+        if self.done.is_set() or self.failure is not None:
+            return True
+        msg_round = msg.get(KEY_ROUND)
+        if msg_round is not None and int(msg_round) != self.round_idx:
+            telemetry.METRICS.inc("round.stale_results")
+            return True
+        if msg.sender in self.dead_peers:
+            return True  # declared dead; its late result is void
+        if msg.sender in self._results:
+            telemetry.METRICS.inc("round.duplicate_results")
+            return True
+        return False
+
     def _handle_result(self, msg: Message) -> None:
+        # cheap checks FIRST: a duplicate or post-close straggler must
+        # not pay the full-pytree scan below
         with self._lock:
-            if self.done.is_set() or self.failure is not None:
+            if self._discard_locked(msg):
                 return
-            msg_round = msg.get(KEY_ROUND)
-            # a straggler's result from an already-closed round must not
-            # leak into the current aggregate (untagged results predate
-            # round-tagging and are accepted for compatibility)
-            if msg_round is not None and int(msg_round) != self.round_idx:
-                return
-            if msg.sender in self.dead_peers:
-                return  # declared dead; its late result is void
-            self._results[msg.sender] = (
-                msg.get(KEY_MODEL_PARAMS),
-                float(msg.get(KEY_NUM_SAMPLES)),
+        params = msg.get(KEY_MODEL_PARAMS)
+        n_k = float(msg.get(KEY_NUM_SAMPLES))
+        # non-finite screening (outside the lock — it touches every
+        # leaf): a single NaN/Inf delta defeats the weighted mean AND
+        # norm-clip (NaN * 0-scale is still NaN), so a poisoned result
+        # never enters the aggregate. The screened rank stays live and
+        # simply has no result this round — it counts against quorum
+        # like a straggler.
+        if not _result_is_finite(params, n_k):
+            telemetry.METRICS.inc("robust.nonfinite_rejected")
+            telemetry.RECORDER.record(
+                "nonfinite_rejected", peer=msg.sender,
+                round=msg.get(KEY_ROUND),
             )
+            return
+        with self._lock:
+            # re-validate: the round can close, or the sender can die
+            # or deliver via another path, while the scan ran unlocked
+            if self._discard_locked(msg):
+                return
+            self._results[msg.sender] = (params, n_k)
         self._maybe_close_round(deadline_fired=False)
 
     def _close_round(
@@ -406,6 +697,21 @@ class FedAvgServerActor(ServerManager):
             rkey,
             local_reducer(),
         )
+        if self._ckpt is not None and (
+            (closed_idx + 1) % self.checkpoint_every == 0
+            or closed_idx + 1 >= self.cfg.fed.num_rounds
+        ):
+            # atomic orbax save of the FULL ServerState — variables,
+            # server-optimizer state, momentum, and the round counter
+            # every RNG fold derives from — keyed by the closed round,
+            # so a SIGKILLed server restarts from here, not round 0
+            self._ckpt.save(closed_idx, self.state)
+            telemetry.METRICS.inc("recovery.checkpoints")
+            telemetry.RECORDER.record("checkpoint", round=closed_idx)
+            # counters ride the checkpoint cadence to disk: a SIGKILLed
+            # server's metrics (rejoins, dedups, ...) survive the crash
+            # instead of dying with the exit-time flush
+            telemetry.flush_metrics()
         if self.on_round_done is not None:
             self.on_round_done(
                 self.round_idx,
@@ -446,6 +752,12 @@ class FedAvgClientActor(ClientManager):
         self.root_key = jax.random.key(cfg.seed)
         self.register_message_receive_handler(
             MSG_TYPE_S2C_SYNC_MODEL, self._handle_sync
+        )
+        # a WELCOME (rejoin reply) carries the same payload as the
+        # round's sync and is worked identically — the server's
+        # keep-first dedup absorbs the case where both arrive
+        self.register_message_receive_handler(
+            MSG_TYPE_S2C_WELCOME, self._handle_sync
         )
 
     def _handle_sync(self, msg: Message) -> None:

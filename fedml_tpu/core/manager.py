@@ -78,10 +78,22 @@ class LivenessMonitor:
             if msg.sender in self.last_seen:
                 self.last_seen[msg.sender] = time.monotonic()
 
-    def _mark_dead(self, peer: int) -> None:
+    def _mark_dead_if_stale(self, peer: int) -> bool:
+        """Commit a death ONLY if the peer is still stale at commit
+        time. A JOIN-triggered :meth:`revive` (or any delivery) that
+        refreshed ``last_seen`` between the caller's detection and this
+        commit RETRACTS the death — without the re-check, an in-flight
+        watchdog could commit a pre-refresh staleness verdict over a
+        completed rejoin and strand a live, heartbeating rank outside
+        the cohort forever. Returns True when the death was committed
+        (the calling watchdog should exit) and False on retraction
+        (keep watching)."""
         with self._lock:
             if peer in self.dead:
-                return
+                return True  # someone else committed; watchdog exits
+            if (time.monotonic() - self.last_seen[peer]
+                    <= self.timeout_s):
+                return False  # heard from since detection — retract
             self.dead.add(peer)
         telemetry.METRICS.inc("manager.dead_peer_events")
         telemetry.RECORDER.record(
@@ -90,6 +102,7 @@ class LivenessMonitor:
         )
         if self.on_dead is not None:
             self.on_dead(peer)
+        return True
 
     def _run_peer(self, peer: int) -> None:
         while not self._stop.wait(self.interval_s):
@@ -102,8 +115,7 @@ class LivenessMonitor:
                     time.monotonic() - self.last_seen[peer]
                     > self.timeout_s
                 )
-            if stale:
-                self._mark_dead(peer)
+            if stale and self._mark_dead_if_stale(peer):
                 return
             try:
                 # hb_ts: the peer's manager echoes it back so the next
@@ -123,8 +135,43 @@ class LivenessMonitor:
                 if (self._stop.is_set()
                         or self.mgr.transport._stopped.is_set()):
                     return
-                self._mark_dead(peer)
+                # a failed beat to a RECENTLY-heard-from peer (e.g. one
+                # that just rejoined on a fresh endpoint) is retracted
+                # by the staleness re-check: keep watching — a truly
+                # dead peer goes stale within timeout_s and commits
+                # then
+                if self._mark_dead_if_stale(peer):
+                    return
+
+    def dead_snapshot(self) -> set[int]:
+        """Consistent snapshot of the peers currently considered dead —
+        the liveness source of truth the server's round-boundary
+        rejoin/death reconciliation reads (docs/FAULT_TOLERANCE.md
+        "Recovery")."""
+        with self._lock:
+            return set(self.dead)
+
+    def revive(self, peer: int) -> None:
+        """Re-arm monitoring for a peer that rejoined after being
+        declared dead (docs/FAULT_TOLERANCE.md "Recovery"). Resets the
+        peer's last-seen clock and restarts its watchdog thread (the old
+        one returned when it fired). ``on_dead`` may therefore fire
+        again for the same rank — once per death, not once per run.
+        Idempotent for peers that were never declared dead (a duplicate
+        JOIN only refreshes last-seen)."""
+        with self._lock:
+            if peer not in self.last_seen:
+                return  # not a monitored peer
+            self.last_seen[peer] = time.monotonic()
+            if peer not in self.dead:
                 return
+            self.dead.discard(peer)
+        t = threading.Thread(
+            target=self._run_peer, args=(peer,), daemon=True,
+            name=f"liveness-rank{self.mgr.rank}-peer{peer}",
+        )
+        t.start()
+        self._threads.append(t)
 
     def stop(self) -> None:
         self._stop.set()
@@ -278,7 +325,9 @@ class Manager:
         on_dead: Callable[[int], None] | None = None,
     ) -> LivenessMonitor:
         """Arm the heartbeat protocol toward ``peers``. ``on_dead(rank)``
-        fires exactly once per peer, from the monitor thread."""
+        fires exactly once per peer DEATH, from the monitor thread (a
+        peer revived via :meth:`LivenessMonitor.revive` is watched again
+        and may die again)."""
         if self.liveness is not None:
             raise RuntimeError("liveness already enabled")
         self.liveness = LivenessMonitor(

@@ -53,6 +53,15 @@ MSG_TYPE_S2C_ACK = 6
 # at transport-deliver time, so heartbeats only matter on otherwise-idle
 # links. See docs/FAULT_TOLERANCE.md.
 MSG_TYPE_HEARTBEAT = 7
+# Recovery handshake (docs/FAULT_TOLERANCE.md "Recovery"): a (re)started
+# client announces itself with JOIN. Before the run is underway JOIN
+# counts toward the readiness barrier exactly like READY; once underway
+# it is a REJOIN — the server re-adds the rank to the live set and
+# replies WELCOME carrying the current round index + global model +
+# client assignment, so the rank resumes work mid-run instead of being
+# excluded until the end of the run.
+MSG_TYPE_C2S_JOIN = 8
+MSG_TYPE_S2C_WELCOME = 9
 
 # Well-known payload keys (reference Message.MSG_ARG_KEY_*)
 KEY_MODEL_PARAMS = "model_params"

@@ -53,7 +53,11 @@ def trimmed_mean(stacked: Pytree, trim_frac: float = 0.1) -> Pytree:
 
     def leaf(x):
         c = x.shape[0]
-        k = int(c * trim_frac)
+        # clamp so at least one row survives: k >= c/2 (over-trimming a
+        # small cohort) would slice an empty range and average to NaN —
+        # the defense must degrade to the median-most rows, not poison
+        # the aggregate it exists to protect
+        k = min(int(c * trim_frac), (c - 1) // 2)
         if k == 0:
             return jnp.mean(x, axis=0)
         s = jnp.sort(x, axis=0)

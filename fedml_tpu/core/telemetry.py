@@ -44,6 +44,7 @@ from __future__ import annotations
 
 import atexit
 import collections
+import glob
 import json
 import os
 import sys
@@ -144,6 +145,11 @@ class FlightRecorder:
         self.enabled = enabled
         self.dir: str | None = None
         self.rank = 0
+        # artifact-name stem: "rank<r>" plus the incarnation suffix a
+        # supervised restart gets (so a restarted rank's dumps never
+        # clobber its predecessor's — docs/FAULT_TOLERANCE.md
+        # "Recovery")
+        self.tag = "rank0"
         self._ring: collections.deque = collections.deque(maxlen=capacity)
         self._lock = threading.Lock()
         self._dumps = 0
@@ -166,7 +172,7 @@ class FlightRecorder:
             n = self._dumps
             events = list(self._ring)
         path = os.path.join(
-            self.dir, f"flight_rank{self.rank}_{n}_{reason}.json"
+            self.dir, f"flight_{self.tag}_{n}_{reason}.json"
         )
         data = {
             "reason": reason,
@@ -194,6 +200,11 @@ TRACER: Tracer | None = None
 
 _DIR: str | None = None
 _RANK = 0
+# incarnation suffix ("" for a rank's first process; "_i<n>" for a
+# supervised restart, chosen in configure() so a restarted rank never
+# overwrites the artifacts its predecessor flushed —
+# scripts/merge_trace.py folds all incarnations of a rank into one pid)
+_SUFFIX = ""
 _tls = threading.local()
 _hooks_installed = False
 
@@ -264,7 +275,7 @@ def configure(
       exit flush that writes ``trace_rank<r>.json`` +
       ``metrics_rank<r>.json``.
     """
-    global TRACER, _DIR, _RANK
+    global TRACER, _DIR, _RANK, _SUFFIX
     _RANK = rank
     METRICS.enabled = True
     RECORDER.rank = rank
@@ -276,12 +287,48 @@ def configure(
     if telemetry_dir:
         os.makedirs(telemetry_dir, exist_ok=True)
         _DIR = telemetry_dir
+        # a previous incarnation of this rank (supervised restart into
+        # the same dir) already left artifacts here: pick the first
+        # free "_i<n>" suffix instead of clobbering them. Flight dumps
+        # count as evidence too — a chaos os._exit rank dies without
+        # ever flushing trace/metrics, and its crash artifacts are
+        # exactly what must not be overwritten.
+        _SUFFIX = ""
+        n = 0
+        while any(
+            os.path.exists(
+                os.path.join(telemetry_dir,
+                             f"{kind}_rank{rank}{_SUFFIX}.json")
+            )
+            for kind in ("trace", "metrics")
+        ) or glob.glob(
+            os.path.join(telemetry_dir,
+                         f"flight_rank{rank}{_SUFFIX}_*.json")
+        ):
+            n += 1
+            _SUFFIX = f"_i{n}"
         RECORDER.dir = telemetry_dir
+        RECORDER.tag = f"rank{rank}{_SUFFIX}"
         RECORDER.enabled = True
         RECORDER._ring = collections.deque(
             RECORDER._ring, maxlen=flight_capacity
         )
         _install_hooks()
+
+
+def flush_metrics() -> None:
+    """Durably snapshot JUST the metrics registry (cheap, bounded —
+    unlike the trace dump, which grows with the run). The server actor
+    calls this at every round checkpoint so counters survive a SIGKILL
+    instead of dying with the exit-time flush (docs/FAULT_TOLERANCE.md
+    "Recovery")."""
+    if _DIR is None:
+        return
+    path = os.path.join(_DIR, f"metrics_rank{_RANK}{_SUFFIX}.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(METRICS.snapshot(), f, indent=2, default=repr)
+    os.replace(tmp, path)
 
 
 def flush() -> None:
@@ -290,26 +337,26 @@ def flush() -> None:
     if _DIR is None:
         return
     if TRACER is not None and TRACER.events:
-        TRACER.dump(os.path.join(_DIR, f"trace_rank{_RANK}.json"))
-    path = os.path.join(_DIR, f"metrics_rank{_RANK}.json")
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(METRICS.snapshot(), f, indent=2, default=repr)
-    os.replace(tmp, path)
+        TRACER.dump(
+            os.path.join(_DIR, f"trace_rank{_RANK}{_SUFFIX}.json")
+        )
+    flush_metrics()
 
 
 def shutdown() -> None:
     """Flush, then return to the all-disabled state (test isolation)."""
-    global TRACER, _DIR
+    global TRACER, _DIR, _SUFFIX
     flush()
     METRICS.enabled = False
     METRICS.reset()
     RECORDER.enabled = False
     RECORDER.dir = None
+    RECORDER.tag = "rank0"
     RECORDER._ring.clear()
     RECORDER._dumps = 0
     TRACER = None
     _DIR = None
+    _SUFFIX = ""
     set_current_trace(None)
 
 

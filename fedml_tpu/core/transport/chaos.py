@@ -44,10 +44,12 @@ import threading
 from fedml_tpu.core import telemetry
 from fedml_tpu.core.message import (
     KEY_ROUND,
+    MSG_TYPE_C2S_JOIN,
     MSG_TYPE_C2S_READY,
     MSG_TYPE_FINISH,
     MSG_TYPE_HEARTBEAT,
     MSG_TYPE_S2C_ACK,
+    MSG_TYPE_S2C_WELCOME,
     Message,
 )
 from fedml_tpu.core.transport.base import BaseTransport
@@ -73,16 +75,22 @@ class FaultPolicy:
     crash_at_round: int | None = None
     crash_mode: str = "silent"  # "silent" | "exit"
     # protected by default: FINISH (so a zero-tolerance run still
-    # terminates) and the liveness/handshake plane (READY/ACK/HEARTBEAT
-    # counts are timing-driven — re-announce loops, monitor threads — so
-    # letting them consume RNG draws would make the WORK-message fault
-    # pattern non-replayable across runs). Chaos on these planes is
-    # opt-in via protect_types=().
+    # terminates) and the liveness/handshake/recovery plane (READY/ACK/
+    # HEARTBEAT/JOIN/WELCOME counts are timing-driven — re-announce
+    # loops, monitor threads, supervised restarts — so letting them
+    # consume RNG draws would make the WORK-message fault pattern
+    # non-replayable across runs). Chaos on these planes is opt-in via
+    # protect_types=(). Note crash_at_round is a RECEIVE-side trigger
+    # and ignores this list: a WELCOME tagged round >= N still kills a
+    # rank whose policy says so — restart argv should drop fault flags
+    # (the Supervisor's restart_argv does).
     protect_types: tuple[int, ...] = (
         MSG_TYPE_FINISH,
         MSG_TYPE_C2S_READY,
         MSG_TYPE_S2C_ACK,
         MSG_TYPE_HEARTBEAT,
+        MSG_TYPE_C2S_JOIN,
+        MSG_TYPE_S2C_WELCOME,
     )
 
     def __post_init__(self):

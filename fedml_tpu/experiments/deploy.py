@@ -61,6 +61,7 @@ from fedml_tpu.config import ExperimentConfig
 from fedml_tpu.core import telemetry
 from fedml_tpu.core.manager import Manager, ServerManager, create_transport
 from fedml_tpu.core.message import (
+    MSG_TYPE_C2S_JOIN,
     MSG_TYPE_C2S_READY,
     MSG_TYPE_S2C_ACK,
     Message,
@@ -95,6 +96,14 @@ class DeployConfig:
     # for every live worker (dead ones are still skipped via heartbeats)
     quorum_fraction: float = 1.0
     round_deadline_s: float | None = None
+    # -- crash recovery (docs/FAULT_TOLERANCE.md "Recovery") ---------------
+    # server rank: checkpoint ServerState every N closed rounds under
+    # <run_dir>/ckpt and resume from the latest checkpoint on restart
+    # (0 = off; the same flag drives the simulator path)
+    checkpoint_every: int = 0
+    # deadline-under-quorum re-arms before the quorum-lost abort fires —
+    # under a supervisor a crashed rank is seconds from rejoining
+    recovery_extensions: int = 0
     # seeded fault injection for THIS rank (None/disabled = real traffic)
     fault: FaultPolicy | None = None
     # -- telemetry (docs/OBSERVABILITY.md) ---------------------------------
@@ -175,13 +184,47 @@ def _server_dead_peer_cb(server: ServerManager):
     return on_dead
 
 
+class _AliveObserver:
+    """Second transport observer on the server: counts the SENDER of
+    every inbound message toward the readiness barrier. In a fresh run
+    this is inert (a fresh client's first message IS its JOIN); after a
+    supervised server restart it is what completes the barrier — the
+    surviving clients are blocked mid-run waiting for the next sync and
+    only emit heartbeats, which prove they are up and reachable."""
+
+    def __init__(self, note):
+        self._note = note
+
+    def receive_message(self, msg_type: int, msg: Message) -> None:
+        self._note(msg.sender)
+
+
 def _serve_with_ready_barrier(
     server: ServerManager, dep: DeployConfig, kickoff
 ) -> None:
-    """ACK every READY, start round 0 once all clients have announced,
-    arm the dead-client watchdog, then drain until the actor finishes."""
+    """ACK every READY/JOIN, start (or resume) the run once all clients
+    have announced or otherwise proven liveness, arm the dead-client
+    watchdog, then drain until the actor finishes. A JOIN arriving AFTER
+    kickoff is a rejoin: it is routed to the actor's ``on_peer_rejoin``
+    (docs/FAULT_TOLERANCE.md "Recovery")."""
     ready: set[int] = set()
     started = threading.Event()
+
+    def note_alive(sender: int) -> None:
+        # observers run on the single dispatch thread — no lock needed
+        if not (1 <= sender < dep.world_size) or started.is_set():
+            return
+        ready.add(sender)
+        if len(ready) >= dep.world_size - 1:
+            started.set()
+            if dep.heartbeats:
+                server.enable_liveness(
+                    range(1, dep.world_size),
+                    interval_s=dep.heartbeat_interval_s,
+                    timeout_s=dep.heartbeat_timeout_s,
+                    on_dead=_server_dead_peer_cb(server),
+                )
+            kickoff()
 
     def on_ready(msg: Message) -> None:
         # ACK unconditionally (duplicates arrive by design — clients
@@ -195,17 +238,17 @@ def _serve_with_ready_barrier(
             )
         except Exception:
             pass  # client endpoint flapped; it will re-announce
-        ready.add(msg.sender)
-        if len(ready) >= dep.world_size - 1 and not started.is_set():
-            started.set()
-            if dep.heartbeats:
-                server.enable_liveness(
-                    range(1, dep.world_size),
-                    interval_s=dep.heartbeat_interval_s,
-                    timeout_s=dep.heartbeat_timeout_s,
-                    on_dead=_server_dead_peer_cb(server),
-                )
-            kickoff()
+        note_alive(msg.sender)
+
+    def on_join(msg: Message) -> None:
+        if started.is_set():
+            rejoin = getattr(server, "on_peer_rejoin", None)
+            if rejoin is not None:
+                rejoin(msg.sender)  # WELCOMEs + revives the rank
+                return
+            # actor without mid-run rejoin (SplitNN's strictly
+            # sequential rounds): ACK so the client stops announcing
+        on_ready(msg)
 
     # NOTE: no per-deploy heartbeat handler anymore. A client's liveness
     # view must be satisfiable BEFORE the barrier completes (its watchdog
@@ -214,6 +257,8 @@ def _serve_with_ready_barrier(
     # ``hb_ts`` is echoed back, which both refreshes the client's
     # last-seen table and closes its RTT gauge loop.
     server.register_message_receive_handler(MSG_TYPE_C2S_READY, on_ready)
+    server.register_message_receive_handler(MSG_TYPE_C2S_JOIN, on_join)
+    server.transport.add_observer(_AliveObserver(note_alive))
     server.transport.start()
     server.run()  # blocks until the actor's finish path stops the transport
 
@@ -221,8 +266,12 @@ def _serve_with_ready_barrier(
 def _announce_until_first_message(
     mgr: Manager, dep: DeployConfig
 ) -> tuple[threading.Event, list[str]]:
-    """Client side: re-send READY until the server's ACK (or any other
-    server message) arrives, then arm the server-liveness watchdog.
+    """Client side: re-send JOIN until the server's ACK (fresh run), its
+    WELCOME (mid-run rejoin), or any other server message arrives, then
+    arm the server-liveness watchdog. A fresh start and a supervised
+    restart are deliberately indistinguishable here — the SERVER decides
+    (pre-kickoff JOIN counts toward the barrier like READY; post-kickoff
+    JOIN is a rejoin, docs/FAULT_TOLERANCE.md "Recovery").
 
     Returns ``(first-inbound event, failure log)``. If ``ready_timeout``
     expires before any server message, the loop STOPS the transport so
@@ -256,7 +305,7 @@ def _announce_until_first_message(
         while not got.is_set() and time.monotonic() < deadline:
             try:
                 mgr.send_message(
-                    Message(MSG_TYPE_C2S_READY, mgr.rank, 0, {})
+                    Message(MSG_TYPE_C2S_JOIN, mgr.rank, 0, {})
                 )
             except Exception:
                 pass  # server endpoint not up yet — retry
@@ -345,15 +394,39 @@ def _run_fedavg_rank(cfg: ExperimentConfig, dep: DeployConfig) -> dict:
             RoundPolicy,
         )
 
+        ckpt = None
+        if dep.checkpoint_every > 0:
+            from fedml_tpu.utils.checkpoint import RoundCheckpointer
+
+            # <run_dir>/ckpt — the same layout the simulator harness
+            # uses, so a deploy run and a sim run of one config share
+            # the resume story (docs/FAULT_TOLERANCE.md "Recovery")
+            ckpt = RoundCheckpointer(os.path.join(_run_dir(cfg), "ckpt"))
         server = FedAvgServerActor(
             dep.world_size, transport, model, cfg,
             num_clients=cfg.data.num_clients, data=data,
             round_policy=RoundPolicy(
                 quorum_fraction=dep.quorum_fraction,
                 round_deadline_s=dep.round_deadline_s,
+                recovery_extensions=dep.recovery_extensions,
             ),
+            checkpointer=ckpt,
+            checkpoint_every=dep.checkpoint_every or 1,
         )
-        _serve_with_ready_barrier(server, dep, server.start_round)
+        try:
+            if server.resumed_from >= cfg.fed.num_rounds:
+                # restored AT the end (crash between the final round
+                # closing and the summary): nothing to run, and the
+                # clients that finished the run may be gone for good —
+                # don't wait on a readiness barrier that can never
+                # complete; just finish and emit the summary
+                server.done.set()
+                server.finish_all()
+            else:
+                _serve_with_ready_barrier(server, dep, server.kickoff)
+        finally:
+            if ckpt is not None:
+                ckpt.close()
         if server.failure is not None:
             raise QuorumLostError(
                 f"run aborted (straggler tolerance exhausted): "
@@ -382,6 +455,9 @@ def _run_fedavg_rank(cfg: ExperimentConfig, dep: DeployConfig) -> dict:
             "backend": dep.backend,
             "world_size": dep.world_size,
             "rounds": server.round_idx,
+            # first round executed by THIS incarnation (0 = fresh start;
+            # > 0 = restored from <run_dir>/ckpt after a crash)
+            "resumed_from": server.resumed_from,
             "final_params": path,
             "params_digest": _params_digest(server.variables),
             "dead_peers": sorted(server.dead_peers),
@@ -408,6 +484,18 @@ def _run_splitnn_rank(cfg: ExperimentConfig, dep: DeployConfig) -> dict:
         raise ValueError(
             "splitnn deployment: world_size must be num_clients+1 "
             f"(got {dep.world_size} vs {cfg.data.num_clients}+1)"
+        )
+    if dep.checkpoint_every:
+        import sys as _sys
+
+        # only the fedavg-family server checkpoints rounds; saying so
+        # loudly beats letting the user believe a splitnn run is
+        # durable (it restarts from round 0 after a crash)
+        print(
+            "warning: --checkpoint_every is ignored for splitnn "
+            "deployments (round checkpointing covers the fedavg "
+            "family only)",
+            file=_sys.stderr,
         )
     data = load_dataset(cfg.data)
     client_model = SplitClientNet()
@@ -463,6 +551,285 @@ def _run_splitnn_rank(cfg: ExperimentConfig, dep: DeployConfig) -> dict:
         "final_params": path,
         "params_digest": _params_digest(client.c_vars),
     }
+
+
+# ---------------------------------------------------------------------------
+# supervised deployment: spawn, watch, restart
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class RankSpec:
+    """One rank's launch recipe for the :class:`Supervisor`.
+
+    ``restart_argv`` (default: ``argv``) is what a RESTARTED incarnation
+    runs — the CLI supervise path strips ``--fault_*`` chaos flags here,
+    so an injected ``--fault_crash_round`` kills the first incarnation
+    exactly once and the replacement runs clean (otherwise the restart
+    would re-crash on the same round's sync, forever)."""
+
+    rank: int
+    argv: list[str]
+    restart_argv: list[str] | None = None
+
+
+class SupervisorError(RuntimeError):
+    """A rank exhausted its restart budget (or the run timed out); the
+    message carries the rank, exit code, and last log path."""
+
+
+class Supervisor:
+    """Process supervisor for a deployment world: spawns every rank,
+    watches exit codes, and restarts crashed ranks with capped
+    exponential backoff (the same :class:`RetryPolicy` schedule the
+    transports use), turning a SIGKILL of any rank into a
+    kill -> restart -> rejoin -> converge loop instead of a dead run
+    (docs/FAULT_TOLERANCE.md "Recovery").
+
+    Exit-code semantics: nonzero — including signal deaths (negative
+    returncodes) and chaos's
+    :data:`~fedml_tpu.core.transport.chaos.CHAOS_EXIT_CODE` — is a
+    crash, restarted until ``max_restarts`` per rank is spent. The run
+    succeeds when the SERVER (rank 0) exits 0; its last stdout line is
+    the run summary. A CLIENT exiting 0 is a genuine end-of-run
+    wind-down when the server is alive and has never crashed (the
+    normal case — the server exits moments later); but when the server
+    has crashed or is mid-restart, a clean client exit means it obeyed
+    a doomed incarnation's FINISH broadcast, so it is respawned after
+    ``finish_grace_s`` — and a server crash likewise *reactivates*
+    clients that were already marked finished. These respawns spend
+    their own ``respawns`` cap, never the crash budget. Each attempt's
+    output goes to ``<log_dir>/rank<r>_try<n>.log`` (a crashed rank's
+    log is named in the failure diagnostic)."""
+
+    def __init__(
+        self,
+        specs: list[RankSpec],
+        *,
+        max_restarts: int = 3,
+        backoff=None,
+        env: dict | None = None,
+        cwd: str | None = None,
+        log_dir: str | None = None,
+        poll_interval_s: float = 0.1,
+        # delay before respawning a client whose clean exit was judged
+        # premature (server crashed / mid-restart); a genuine
+        # end-of-run never schedules one
+        finish_grace_s: float = 5.0,
+    ):
+        import tempfile
+
+        from fedml_tpu.core.transport.retry import RetryPolicy
+
+        self.specs = {s.rank: s for s in specs}
+        assert 0 in self.specs, "the supervisor needs a server (rank 0)"
+        self.max_restarts = max_restarts
+        self.backoff = backoff or RetryPolicy(
+            max_attempts=max_restarts + 1, base_delay_s=0.5,
+            max_delay_s=10.0, jitter=0.25, deadline_s=float("inf"),
+        )
+        self.env = env
+        self.cwd = cwd
+        self.log_dir = log_dir or tempfile.mkdtemp(prefix="fedml_sup_")
+        os.makedirs(self.log_dir, exist_ok=True)
+        self.poll_interval_s = poll_interval_s
+        self.finish_grace_s = finish_grace_s
+        self.procs: dict[int, "subprocess.Popen"] = {}
+        self.restarts: dict[int, int] = {r: 0 for r in self.specs}
+        self.respawns: dict[int, int] = {r: 0 for r in self.specs}
+        self.exited: dict[int, int] = {}  # rank -> rc for clean exits
+        self.log_paths: dict[int, list[str]] = {r: [] for r in self.specs}
+        self._fhs: list = []
+        self._pending: dict[int, float] = {}  # rank -> respawn-at time
+        import random as _random
+
+        self._rng = _random.Random(0)
+
+    def _spawn(self, rank: int, argv: list[str]) -> None:
+        import subprocess
+
+        n = len(self.log_paths[rank])
+        path = os.path.join(self.log_dir, f"rank{rank}_try{n}.log")
+        fh = open(path, "w")
+        self._fhs.append(fh)
+        self.log_paths[rank].append(path)
+        self.procs[rank] = subprocess.Popen(
+            argv, env=self.env, cwd=self.cwd, stdout=fh,
+            stderr=subprocess.STDOUT,
+        )
+
+    def _terminate_all(self) -> None:
+        for p in self.procs.values():
+            try:
+                p.terminate()
+            except Exception:
+                pass
+        deadline = time.monotonic() + 5
+        for p in self.procs.values():
+            try:
+                p.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except Exception:
+                try:
+                    p.kill()
+                except Exception:
+                    pass
+        self.procs.clear()
+        self._pending.clear()
+        for fh in self._fhs:
+            try:
+                fh.close()
+            except Exception:
+                pass
+
+    def _server_healthy(self) -> bool:
+        """True while rank 0 is alive RIGHT NOW (not crashed, not
+        awaiting respawn). Prior crashes don't matter: a client exiting
+        0 under a live server incarnation is a genuine wind-down even
+        after a recovery (the restarted server's own post-run work can
+        take tens of seconds), and the one mis-classification this
+        allows — a doomed server broadcasting FINISH moments before its
+        own death — is repaired by the rank-0 crash handler, which
+        reactivates every already-finished client."""
+        proc = self.procs.get(0)
+        return (
+            0 not in self._pending
+            and proc is not None
+            and proc.poll() is None
+        )
+
+    def _respawn_finished_client(self, rank: int) -> None:
+        """Schedule a respawn for a client whose clean exit was judged
+        premature (it obeyed a doomed server incarnation's FINISH).
+        Spends the respawn cap, not the crash budget."""
+        if self.respawns[rank] >= max(3, self.max_restarts):
+            self._terminate_all()
+            raise SupervisorError(
+                f"rank {rank} kept finishing prematurely "
+                f"({self.respawns[rank]} respawns) while the "
+                f"server never completed; last log: "
+                f"{self.log_paths[rank][-1]}"
+            )
+        self.respawns[rank] += 1
+        telemetry.RECORDER.record(
+            "premature_finish", rank=rank,
+            respawn=self.respawns[rank],
+        )
+        self._pending[rank] = time.monotonic() + self.finish_grace_s
+
+    def _on_exit(self, rank: int, rc: int) -> None:
+        if rc == 0:
+            if rank == 0 or self._server_healthy():
+                # the server completing, or a client winding down while
+                # a never-crashed server finishes its post-run work
+                # (eval + summary can take tens of seconds cold) — a
+                # genuine finish, not a failure
+                self.exited[rank] = 0
+                return
+            # server crashed / mid-restart: this client's FINISH came
+            # from a doomed incarnation — bring it back so the
+            # restarted server's barrier can complete
+            self._respawn_finished_client(rank)
+            return
+        if self.restarts[rank] >= self.max_restarts:
+            self._terminate_all()
+            raise SupervisorError(
+                f"rank {rank} exited rc={rc} with its restart budget "
+                f"({self.max_restarts}) spent; last log: "
+                f"{self.log_paths[rank][-1]}"
+            )
+        pause = self.backoff.delay(self.restarts[rank], self._rng)
+        self.restarts[rank] += 1
+        telemetry.METRICS.inc("recovery.restarts")
+        # every restart is a flight-recorder trigger: the artifact names
+        # the rank, the exit code, and the backoff it sat out
+        telemetry.flight_dump(
+            "restart", rank=rank, code=rc,
+            attempt=self.restarts[rank], delay_s=pause,
+        )
+        self._pending[rank] = time.monotonic() + pause
+        if rank == 0:
+            # the dying server may have FINISHed clients into clean
+            # exits moments before it crashed — reactivate them; its
+            # restarted incarnation needs them back at the barrier
+            for r in [r for r in self.exited if r != 0]:
+                del self.exited[r]
+                self._respawn_finished_client(r)
+
+    def run(self, timeout: float | None = None) -> dict:
+        """Supervise until the server completes (returns the run
+        summary parsed from its stdout) or a budget is exhausted
+        (raises :class:`SupervisorError`)."""
+        import json as _json
+
+        deadline = (
+            time.monotonic() + timeout if timeout is not None else None
+        )
+        try:
+            for rank in sorted(self.specs, reverse=True):  # clients 1st
+                self._spawn(rank, self.specs[rank].argv)
+            while True:
+                now = time.monotonic()
+                if deadline is not None and now > deadline:
+                    raise SupervisorError(
+                        f"run exceeded its {timeout}s budget "
+                        f"(restarts so far: {self.restarts})"
+                    )
+                for rank, at in list(self._pending.items()):
+                    if now >= at:
+                        del self._pending[rank]
+                        spec = self.specs[rank]
+                        self._spawn(
+                            rank, spec.restart_argv or spec.argv
+                        )
+                for rank, proc in list(self.procs.items()):
+                    rc = proc.poll()
+                    if rc is None:
+                        continue
+                    del self.procs[rank]
+                    self._on_exit(rank, rc)
+                if self.exited.get(0) == 0:
+                    break
+                if not self.procs and not self._pending:
+                    raise SupervisorError(
+                        "every rank exited but the server never "
+                        f"completed (clean exits: {self.exited})"
+                    )
+                time.sleep(self.poll_interval_s)
+            # server done: clients received FINISH — give them a grace
+            # window to unwind, then stop any leftovers
+            grace = time.monotonic() + 15
+            for p in self.procs.values():
+                try:
+                    p.wait(timeout=max(0.1, grace - time.monotonic()))
+                except Exception:
+                    pass
+        finally:
+            self._terminate_all()
+        with open(self.log_paths[0][-1]) as f:
+            lines = [ln for ln in f.read().splitlines() if ln.strip()]
+        summary = None
+        for ln in reversed(lines):  # stderr shares the file: take the
+            try:                    # last line that IS the summary JSON
+                cand = _json.loads(ln)
+            except ValueError:
+                continue
+            # json.loads also accepts bare scalars ('1.0', 'true',
+            # quoted strings) that a trailing library/log line can
+            # produce — the rank summary is always an object
+            if isinstance(cand, dict):
+                summary = cand
+                break
+        if summary is None:
+            raise SupervisorError(
+                f"server completed but its log carries no summary "
+                f"JSON ({self.log_paths[0][-1]})"
+            )
+        return {
+            "summary": summary,
+            "restarts": dict(self.restarts),
+            "respawns": dict(self.respawns),
+            "logs": {r: list(p) for r, p in self.log_paths.items()},
+        }
 
 
 def run_role(cfg: ExperimentConfig, dep: DeployConfig) -> dict:

@@ -346,8 +346,10 @@ class Experiment:
         (host-driven or run-only shapes) cannot checkpoint — the flag
         warns and falls back to a plain run. On resume after a
         mid-interval crash, rounds after the last checkpoint re-run and
-        re-log: metrics.jsonl may carry a duplicate round record (the
-        later, post-``resumed_from`` one is authoritative)."""
+        re-log: metrics.jsonl may carry a duplicate round record — the
+        later one is authoritative, machine-checkably so: every row a
+        resumed incarnation logs carries ``resumed: true`` (consumers
+        keep the ``resumed`` row when a round number appears twice)."""
         ckpt = None
         start_round = 0
         checkpointable = (
@@ -419,6 +421,10 @@ class Experiment:
                     )
                     state, m = out
             record = {"round": r}
+            if start_round:
+                # this incarnation resumed mid-run: its rows win over
+                # any pre-crash row for the same round
+                record["resumed"] = True
             if isinstance(m, dict):
                 record.update({k: _f(v) for k, v in m.items()
                                if _scalar(v)})

@@ -69,7 +69,10 @@ def parse_args(argv=None) -> tuple[ExperimentConfig, argparse.Namespace]:
     p.add_argument("--checkpoint_every", type=int, default=None,
                    help="checkpoint round state every N rounds into "
                         "<out_dir>/<run>/ckpt and resume from the "
-                        "latest checkpoint on restart (0 = off)")
+                        "latest checkpoint on restart (0 = off; works "
+                        "for the simulator AND the fedavg-family "
+                        "--role server deployment path; splitnn "
+                        "deployments do not checkpoint)")
     # -- telemetry (docs/OBSERVABILITY.md) ---------------------------------
     p.add_argument("--telemetry_dir", type=str, default=None,
                    help="enable the telemetry plane and write THIS "
@@ -131,6 +134,18 @@ def parse_args(argv=None) -> tuple[ExperimentConfig, argparse.Namespace]:
                    help="per-round wall-clock budget in seconds: at "
                         "expiry the round closes with >= quorum results "
                         "or the run aborts (0/unset = no deadline)")
+    # -- crash recovery (docs/FAULT_TOLERANCE.md "Recovery") ---------------
+    p.add_argument("--recovery_extensions", type=int, default=0,
+                   help="times a round deadline that expires UNDER "
+                        "quorum re-arms (waiting for restarted ranks "
+                        "to rejoin) before the quorum-lost abort fires")
+    p.add_argument("--supervise", action="store_true",
+                   help="launch ALL ranks of the deployment on this "
+                        "host under a Supervisor that restarts crashed "
+                        "processes with capped backoff (requires "
+                        "--world_size; do not pass --role/--rank)")
+    p.add_argument("--max_restarts", type=int, default=3,
+                   help="per-rank restart budget under --supervise")
     # -- seeded fault injection for THIS rank (chaos testing) --------------
     p.add_argument("--fault_seed", type=int, default=0,
                    help="seed for the deterministic fault stream")
@@ -266,12 +281,14 @@ def _deploy_config(a) -> "DeployConfig":
             "under --role (each deployment process runs exactly one rank)",
             file=sys.stderr,
         )
-    if a.checkpoint_every:
-        print(
-            "warning: --checkpoint_every is a simulator flag and is "
-            "ignored under --role (the actor runtime has no round "
-            "checkpointing yet)",
-            file=sys.stderr,
+    if a.recovery_extensions and not a.round_deadline:
+        # fail at argument time with the pairing rule, not per-rank
+        # (under a supervisor the server would otherwise crash-loop on
+        # RoundPolicy's ValueError until the restart budget is spent)
+        raise SystemExit(
+            "--recovery_extensions requires --round_deadline: "
+            "extensions re-arm the round deadline, so without one "
+            "there is nothing to extend"
         )
     broker = _parse_broker(a.broker) if a.broker is not None else None
     return DeployConfig(
@@ -293,12 +310,108 @@ def _deploy_config(a) -> "DeployConfig":
         round_deadline_s=(
             a.round_deadline if a.round_deadline else None
         ),
+        checkpoint_every=a.checkpoint_every or 0,
+        recovery_extensions=a.recovery_extensions,
         fault=_fault_policy(a),
     )
 
 
+def _strip_flags(
+    argv: list[str], bare=(), valued=(), prefixes=()
+) -> list[str]:
+    """Remove flags from a raw argv list: ``bare`` take no value,
+    ``valued`` (and any flag matching a ``prefixes`` entry) consume the
+    next token unless given as ``--flag=value``."""
+    out, i = [], 0
+    while i < len(argv):
+        tok = argv[i]
+        name = tok.split("=", 1)[0]
+        if name in bare:
+            i += 1
+            continue
+        if name in valued or any(name.startswith(p) for p in prefixes):
+            i += 1 if "=" in tok else 2
+            continue
+        out.append(tok)
+        i += 1
+    return out
+
+
+def _run_supervised(a, argv: list[str]) -> int:
+    """``--supervise``: launch the whole world (server + clients) on
+    this host under a :class:`~fedml_tpu.experiments.deploy.Supervisor`.
+    Every rank runs this same CLI with ``--role``/``--rank`` appended;
+    restarted incarnations run WITHOUT the ``--fault_*`` chaos flags,
+    so an injected crash happens once and its replacement runs clean
+    (the kill -> restart -> rejoin -> converge loop,
+    docs/FAULT_TOLERANCE.md "Recovery")."""
+    from fedml_tpu.experiments.deploy import RankSpec, Supervisor
+
+    if a.role is not None:
+        raise SystemExit(
+            "--supervise launches every rank itself; drop --role/--rank"
+        )
+    if a.world_size is None or a.world_size < 2:
+        raise SystemExit("--supervise requires --world_size >= 2")
+    if a.no_heartbeats:
+        raise SystemExit(
+            "--supervise requires the liveness protocol: after a "
+            "server restart the readiness barrier completes via the "
+            "surviving clients' heartbeats — with --no_heartbeats the "
+            "restarted server would wait forever"
+        )
+    if a.recovery_extensions and not a.round_deadline:
+        raise SystemExit(
+            "--recovery_extensions requires --round_deadline: "
+            "extensions re-arm the round deadline, so without one "
+            "there is nothing to extend"
+        )
+    if a.telemetry_dir:
+        from fedml_tpu.core import telemetry
+
+        # the supervisor is its own telemetry process; rank world_size
+        # (one past the last client) keeps its artifacts from
+        # colliding with the server's rank-0 files
+        telemetry.configure(telemetry_dir=a.telemetry_dir,
+                            rank=a.world_size)
+    base = _strip_flags(argv, bare={"--supervise"},
+                        valued={"--max_restarts"})
+    clean = _strip_flags(base, prefixes=("--fault_",))
+    entry = [sys.executable, "-m", "fedml_tpu.experiments.run"]
+    specs = [
+        RankSpec(
+            rank=0,
+            argv=[*entry, *base, "--role", "server"],
+            restart_argv=[*entry, *clean, "--role", "server"],
+        )
+    ]
+    for r in range(1, a.world_size):
+        specs.append(
+            RankSpec(
+                rank=r,
+                argv=[*entry, *base, "--role", "client",
+                      "--rank", str(r)],
+                restart_argv=[*entry, *clean, "--role", "client",
+                              "--rank", str(r)],
+            )
+        )
+    sup = Supervisor(
+        specs, max_restarts=a.max_restarts, env=dict(os.environ)
+    )
+    result = sup.run()
+    print(json.dumps(
+        {**result["summary"], "restarts": result["restarts"]},
+        default=float,
+    ))
+    return 0
+
+
 def main(argv=None) -> int:
     cfg, a = parse_args(argv)
+    if a.supervise:
+        return _run_supervised(
+            a, list(sys.argv[1:] if argv is None else argv)
+        )
     if a.role is not None:
         from fedml_tpu.experiments.deploy import run_role
 

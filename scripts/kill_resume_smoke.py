@@ -1,0 +1,144 @@
+"""CI smoke: SIGKILL a deployed server mid-run, relaunch, resume.
+
+Drives the crash-recovery contract end to end over real sockets
+(docs/FAULT_TOLERANCE.md "Recovery"): a 2-rank gRPC deployment runs
+with ``--checkpoint_every 1``; once the round-1 checkpoint lands the
+server is SIGKILLed (the deterministic spot preemption), the world is
+relaunched into the same run directory, and the relaunched server must
+report ``resumed_from > 0`` and finish every configured round.
+
+Usage::
+
+    python scripts/kill_resume_smoke.py OUT_DIR
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ROUNDS = 6
+
+
+def _free_ports(n):
+    socks = [socket.socket() for _ in range(n)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def main(out_dir: str) -> int:
+    os.makedirs(out_dir, exist_ok=True)
+    cfg = {
+        "data": {"dataset": "fake_mnist", "num_clients": 1,
+                 "batch_size": 32, "partition_method": "homo", "seed": 0},
+        "model": {"name": "lr", "num_classes": 10,
+                  "input_shape": [28, 28, 1]},
+        "train": {"lr": 0.1, "epochs": 1},
+        "fed": {"algorithm": "fedavg", "num_rounds": ROUNDS,
+                "clients_per_round": 1, "eval_every": ROUNDS},
+        "seed": 0,
+        "run_name": "kill_resume",
+        "out_dir": out_dir,
+    }
+    cfg_path = os.path.join(out_dir, "cfg.json")
+    with open(cfg_path, "w") as f:
+        json.dump(cfg, f)
+    ports = _free_ports(2)
+    ip_path = os.path.join(out_dir, "ip.json")
+    with open(ip_path, "w") as f:
+        json.dump({str(r): ["127.0.0.1", ports[r]] for r in range(2)}, f)
+    base = [sys.executable, "-m", "fedml_tpu.experiments.run",
+            "--config", cfg_path, "--backend", "grpc",
+            "--world_size", "2", "--ip_config", ip_path,
+            "--ready_timeout", "120", "--checkpoint_every", "1",
+            "--heartbeat_interval", "0.5", "--heartbeat_timeout", "8"]
+    env = _env()
+
+    def spawn(role, rank=None):
+        argv = [*base, "--role", role]
+        if rank is not None:
+            argv += ["--rank", str(rank)]
+        return subprocess.Popen(argv, env=env, cwd=REPO,
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT, text=True)
+
+    # -- phase 1: run until the round-1 checkpoint lands, then SIGKILL --
+    client = spawn("client", 1)
+    server = spawn("server")
+    ckpt_dir = os.path.join(out_dir, "kill_resume", "ckpt")
+    deadline = time.monotonic() + 240
+    killed = False
+    while time.monotonic() < deadline:
+        if server.poll() is not None:
+            out = server.communicate()[0]
+            client.kill()
+            raise SystemExit(
+                f"server exited rc={server.returncode} before the "
+                f"kill point:\n{out}"
+            )
+        steps = []
+        if os.path.isdir(ckpt_dir):
+            steps = [int(d) for d in os.listdir(ckpt_dir) if d.isdigit()]
+        if steps and max(steps) >= 1:
+            os.kill(server.pid, signal.SIGKILL)
+            killed = True
+            break
+        time.sleep(0.05)
+    if not killed:
+        server.kill()
+        client.kill()
+        raise SystemExit("round-1 checkpoint never appeared")
+    server.wait(timeout=30)
+    # the orphaned client notices the dead server (or we stop waiting)
+    try:
+        client.wait(timeout=60)
+    except subprocess.TimeoutExpired:
+        client.kill()
+        client.wait(timeout=10)
+    killed_round = max(
+        int(d) for d in os.listdir(ckpt_dir) if d.isdigit()
+    )
+    print(f"phase 1: server SIGKILLed with checkpoints through round "
+          f"{killed_round}")
+
+    # -- phase 2: relaunch the same world; the server must resume --
+    client = spawn("client", 1)
+    server = spawn("server")
+    s_out = server.communicate(timeout=300)[0]
+    try:
+        client.communicate(timeout=60)
+    except subprocess.TimeoutExpired:
+        client.kill()
+    if server.returncode != 0:
+        raise SystemExit(
+            f"relaunched server failed rc={server.returncode}:\n{s_out}"
+        )
+    summary = json.loads(s_out.strip().splitlines()[-1])
+    assert summary["resumed_from"] > 0, summary
+    assert summary["rounds"] == ROUNDS, summary
+    print(f"kill-resume smoke ok: resumed_from={summary['resumed_from']}"
+          f", rounds={summary['rounds']}, acc={summary.get('acc'):.3f}")
+    return 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) != 2:
+        raise SystemExit("usage: kill_resume_smoke.py OUT_DIR")
+    sys.exit(main(sys.argv[1]))

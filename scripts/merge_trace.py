@@ -59,10 +59,22 @@ _STRUCTURAL = ("kind", "ts", "seconds", "rank", "tid", "name")
 
 
 def merge(paths: list[str]) -> dict:
-    """Fold tracer dumps into a Chrome trace-event dict."""
+    """Fold tracer dumps into a Chrome trace-event dict.
+
+    A supervised deployment (docs/FAULT_TOLERANCE.md "Recovery") leaves
+    MULTIPLE dumps per rank — ``trace_rank<r>.json`` from the first
+    incarnation, ``trace_rank<r>_i<n>.json`` from each restart. All of a
+    rank's incarnations fold into the same pid (events carry their
+    rank), so the timeline shows the crash gap and the resumed work on
+    one track. A dump a SIGKILLed process left unreadable is skipped
+    with a warning rather than sinking the merge."""
     events: list[dict] = []
     for p in paths:
-        events.extend(load_rank_events(p))
+        try:
+            events.extend(load_rank_events(p))
+        except (json.JSONDecodeError, OSError, KeyError, TypeError) as e:
+            print(f"warning: skipping unreadable dump {p!r}: {e}",
+                  file=sys.stderr)
     if not events:
         return {"traceEvents": [], "displayTimeUnit": "ms"}
     ts0 = min(float(ev.get("ts", 0.0)) for ev in events)

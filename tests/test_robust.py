@@ -1,0 +1,79 @@
+"""Edge-case coverage for the robust-aggregation primitives
+(``core/robust.py``): even-count medians, over-trimmed trimmed mean,
+single-client cohorts, and norm-clipping an all-zero delta — the
+degenerate cohort shapes a straggler-tolerant server actually produces
+once deadlines, quorums, and non-finite screening shrink the round
+(docs/FAULT_TOLERANCE.md)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.core import robust
+
+
+def _stack(rows):
+    return {"w": jnp.asarray(rows, dtype=jnp.float32)}
+
+
+def test_coordinate_median_even_client_count():
+    """Even cohort: the median is the midpoint of the two central
+    values, per coordinate."""
+    stacked = _stack([[1.0, 10.0], [2.0, 20.0], [3.0, 30.0],
+                      [100.0, -100.0]])
+    out = robust.coordinate_median(stacked)
+    np.testing.assert_allclose(np.asarray(out["w"]), [2.5, 15.0])
+
+
+def test_coordinate_median_single_client_is_identity():
+    stacked = _stack([[7.0, -3.0]])
+    out = robust.coordinate_median(stacked)
+    np.testing.assert_allclose(np.asarray(out["w"]), [7.0, -3.0])
+
+
+def test_trimmed_mean_trim_geq_cohort_stays_finite():
+    """Over-trimming (trim_frac high enough that k >= cohort/2 — e.g. a
+    quorum-shrunk round) must NOT average an empty slice into NaN; the
+    defense degrades to the median-most rows."""
+    stacked = _stack([[1.0], [2.0], [3.0], [1000.0]])
+    out = robust.trimmed_mean(stacked, trim_frac=0.9)
+    got = np.asarray(out["w"])
+    assert np.all(np.isfinite(got))
+    # k clamps to (4-1)//2 = 1: mean of the middle rows [2, 3]
+    np.testing.assert_allclose(got, [2.5])
+
+
+def test_trimmed_mean_single_client_cohort():
+    """A one-client cohort cannot trim anything: the 'mean' is that
+    client's delta, finite regardless of trim_frac."""
+    stacked = _stack([[5.0, -1.0]])
+    for frac in (0.0, 0.1, 0.5, 0.99):
+        out = robust.trimmed_mean(stacked, trim_frac=frac)
+        got = np.asarray(out["w"])
+        assert np.all(np.isfinite(got))
+        np.testing.assert_allclose(got, [5.0, -1.0])
+
+
+def test_trimmed_mean_zero_trim_is_mean():
+    stacked = _stack([[1.0], [3.0]])
+    out = robust.trimmed_mean(stacked, trim_frac=0.0)
+    np.testing.assert_allclose(np.asarray(out["w"]), [2.0])
+
+
+def test_norm_clip_all_zero_delta_no_nan():
+    """An all-zero delta (a client whose local update was a no-op) has
+    norm 0: the clip scale must not divide 0/0 into NaN — the zero
+    delta passes through untouched and its cohort-mates still clip."""
+    big = [3.0, 4.0]  # norm 5
+    stacked = _stack([[0.0, 0.0], big])
+    out = robust.clip_deltas_by_norm(stacked, clip=1.0)
+    got = np.asarray(out["w"])
+    assert np.all(np.isfinite(got))
+    np.testing.assert_allclose(got[0], [0.0, 0.0])
+    np.testing.assert_allclose(got[1], [0.6, 0.8], rtol=1e-6)
+
+
+def test_norm_clip_under_threshold_untouched():
+    stacked = _stack([[0.3, 0.4]])  # norm 0.5 < clip
+    out = robust.clip_deltas_by_norm(stacked, clip=1.0)
+    np.testing.assert_allclose(np.asarray(out["w"]), [[0.3, 0.4]],
+                               rtol=1e-6)
