@@ -1200,6 +1200,68 @@ def synthetic_leaf_acc_record(max_rounds: int = 200) -> dict | None:
     }
 
 
+def defense_overhead_records(cohorts=(10, 50), iters=10):
+    """Per-round cost of each Byzantine aggregation defense vs the
+    plain weighted mean (docs/FAULT_TOLERANCE.md "Threat model"), on a
+    ResNet-56-sized delta stack at the standard cohort sizes. Measures
+    ONLY the server-side aggregation op (jitted, synced per batch of
+    iterations) — the number a deployment pays per round for turning a
+    defense on. One record per cohort size; ``value`` is the worst
+    defense's added ms/round, per-method timings ride alongside."""
+    import jax
+    import jax.numpy as jnp
+
+    from fedml_tpu.core import robust
+    from fedml_tpu.core import tree as T
+
+    # ResNet-56-class parameter mass (~0.86M) as a small pytree
+    def stack_for(c):
+        key = jax.random.key(0)
+        return {
+            "w": jax.random.normal(key, (c, 860, 1000), jnp.float32),
+            "b": jax.random.normal(key, (c, 1210), jnp.float32),
+        }
+
+    methods = {
+        "mean": lambda s, w: T.tree_weighted_mean(s, w),
+        "median": lambda s, w: robust.coordinate_median(s),
+        "trimmed_mean": lambda s, w: robust.trimmed_mean(s),
+        "krum": lambda s, w: robust.krum(s, max(1, s["b"].shape[0] // 5))[0],
+        "multikrum": lambda s, w: robust.multi_krum(
+            s, w, max(1, s["b"].shape[0] // 5))[0],
+        "fltrust": lambda s, w: robust.fltrust(
+            s, robust.coordinate_median(s))[0],
+    }
+    records = []
+    for c in cohorts:
+        stacked = stack_for(c)
+        weights = jnp.ones((c,))
+        ms = {}
+        for name, fn in methods.items():
+            jitted = jax.jit(fn)
+            jax.block_until_ready(jitted(stacked, weights))  # compile
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = jitted(stacked, weights)
+            jax.block_until_ready(out)
+            ms[name] = (time.perf_counter() - t0) / iters * 1e3
+        overhead = {k: ms[k] - ms["mean"] for k in ms if k != "mean"}
+        records.append({
+            "metric": f"defense_agg_overhead_ms_c{c}",
+            "value": max(overhead.values()),
+            "unit": "ms/round",
+            "cohort": c,
+            "params": int(sum(
+                v.size // c for v in stacked.values()
+            )),
+            "agg_ms": {k: round(v, 4) for k, v in ms.items()},
+            "overhead_vs_mean_ms": {
+                k: round(v, 4) for k, v in overhead.items()
+            },
+        })
+    return records
+
+
 def main():
     ap = argparse.ArgumentParser(
         description="Plain `python bench.py` (what the driver runs) "
@@ -1238,6 +1300,10 @@ def main():
                     help="ONLY the 50-client sampled-cohort FedGDKD "
                          "rate line (beyond the reference's 10-client "
                          "cap)")
+    ap.add_argument("--defense-bench", action="store_true",
+                    help="ONLY the Byzantine-defense aggregation "
+                         "overhead stage (krum/multikrum/fltrust/"
+                         "median/trimmed_mean vs plain mean)")
     args = ap.parse_args()
 
     # Fail FAST if the device backend cannot come up: a wedged TPU
@@ -1329,6 +1395,10 @@ def main():
         with telemetry.TRACER.span(f"bench.{name}"):
             return fn()
 
+    if args.defense_bench:
+        for rec in staged("defense", defense_overhead_records):
+            emit(rec)
+        return
     if args.synthetic_acc:
         rec = staged("synthetic_acc", synthetic_leaf_acc_record)
         if rec:
@@ -1422,6 +1492,13 @@ def main():
         ))
     except Exception as err:
         print(f"[bench] fedgdkd-scale failed: {err}", file=sys.stderr,
+              flush=True)
+    try:
+        # Byzantine-defense aggregation overhead (cheap: agg op only)
+        for rec in staged("defense", defense_overhead_records):
+            emit(rec)
+    except Exception as err:
+        print(f"[bench] defense stage failed: {err}", file=sys.stderr,
               flush=True)
     sim, _ = build_sim(model_name="resnet56")
     emit(staged(

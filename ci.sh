@@ -90,6 +90,12 @@ print(f"telemetry smoke ok: {len(evs)} events, "
       f"{len(linked)} cross-rank message flows")
 EOF
 
+echo "== byzantine smoke: sign-flip adversary vs multi-Krum =="
+# a 4-rank loopback world with one sign-flip adversary and the
+# multi-Krum defense must complete, converge, and visibly exclude the
+# poisoned results (docs/FAULT_TOLERANCE.md "Threat model")
+JAX_PLATFORMS=cpu python scripts/byzantine_smoke.py "$OUT/byzantine"
+
 echo "== recovery smoke: SIGKILL server -> relaunch -> resume =="
 # a 2-rank gRPC deployment with --checkpoint_every 1 is SIGKILLed
 # mid-run and relaunched; the relaunched server must report

@@ -24,8 +24,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from fedml_tpu.config import ExperimentConfig
-from fedml_tpu.core import telemetry
+from fedml_tpu.core import adversary as A
+from fedml_tpu.core import robust, telemetry
 from fedml_tpu.core import tree as T
+from fedml_tpu.core.reputation import QuarantinePolicy, ReputationTracker
 from fedml_tpu.core.manager import ClientManager, ServerManager
 from fedml_tpu.core.message import (
     KEY_CLIENT_INDEX,
@@ -147,6 +149,7 @@ class FedAvgServerActor(ServerManager):
         round_policy: RoundPolicy | None = None,
         checkpointer=None,
         checkpoint_every: int = 1,
+        quarantine: QuarantinePolicy | None = None,
     ):
         super().__init__(0, size, transport)
         self.cfg = cfg
@@ -244,13 +247,55 @@ class FedAvgServerActor(ServerManager):
         self._ckpt = checkpointer
         self.checkpoint_every = checkpoint_every
         self.resumed_from = 0
+        # -- Byzantine defense plane (docs/FAULT_TOLERANCE.md "Threat
+        # model"): the per-round defense rule rides cfg.fed.robust_*
+        # through server_update; the cross-round reputation tracker
+        # accumulates anomaly scores and quarantines repeat offenders —
+        # excluded from aggregation but still served, so a false
+        # positive can earn its way back. Its state persists through
+        # the round checkpointer below: a restarted server does not
+        # forget who it banned.
+        self._pipeline = robust.DefensePipeline.from_fed(cfg.fed)
+        # surface the contradiction at construction, before the
+        # readiness barrier — not at the first round close, where a
+        # supervised deployment would crash-loop its restart budget
+        robust.check_fednova_compat(cfg.fed.algorithm,
+                                    self._pipeline.method)
+        self._quarantine = quarantine or QuarantinePolicy()
+        self._reputation = ReputationTracker(size, self._quarantine)
+        self._diag_fn = None  # lazily-jitted anomaly scorer
         if checkpointer is not None:
             if checkpoint_every < 1:
                 raise ValueError(
                     f"checkpoint_every must be >= 1 with a checkpointer, "
                     f"got {checkpoint_every}"
                 )
-            self.state, start = checkpointer.restore_or(self.state)
+            template = {
+                "server": self.state,
+                "reputation": self._reputation.state_arrays(),
+            }
+            try:
+                restored, start = checkpointer.restore_or(template)
+            except (ValueError, KeyError, TypeError):
+                # checkpoint written before the reputation plane: the
+                # payload is a bare ServerState. Restore it under the
+                # legacy template and start with a clean reputation —
+                # an upgraded server must resume, not crash-loop the
+                # Supervisor's restart budget away.
+                state, start = checkpointer.restore_or(self.state)
+                restored = {
+                    "server": state,
+                    "reputation": self._reputation.state_arrays(),
+                }
+                import warnings
+
+                warnings.warn(
+                    "restored a pre-reputation checkpoint (bare "
+                    "ServerState); quarantine state starts fresh",
+                    stacklevel=2,
+                )
+            self.state = restored["server"]
+            self._reputation.load_arrays(restored["reputation"])
             if start:
                 if int(self.state.round) != start:
                     raise ValueError(
@@ -649,6 +694,106 @@ class FedAvgServerActor(ServerManager):
             self._results[msg.sender] = (params, n_k)
         self._maybe_close_round(deadline_fired=False)
 
+    @property
+    def quarantined_ranks(self) -> list[int]:
+        return self._reputation.quarantined()
+
+    def _diagnose(self, stacked_vars) -> dict[str, np.ndarray]:
+        """Per-client anomaly scores over this round's results (one
+        jitted flatten + gram matmul, core/robust.anomaly_scores);
+        recompiles per distinct result count, which a quorum-shrunk
+        round changes rarely."""
+        if self._diag_fn is None:
+            def fn(stacked_params, gp):
+                deltas = jax.tree.map(
+                    lambda s, g: s - g[None], stacked_params, gp
+                )
+                return robust.anomaly_scores(deltas)
+
+            self._diag_fn = jax.jit(fn)
+        out = self._diag_fn(
+            stacked_vars["params"], self.state.variables["params"]
+        )
+        return {k: np.asarray(v) for k, v in out.items()}
+
+    def _score_and_exclude(
+        self, results: dict[int, tuple[dict, float]], closed_idx: int
+    ) -> tuple[list[int], dict | None]:
+        """The reputation pass over one closed round's results: score
+        every reporter, fold into the cross-round tracker, and return
+        ``(included ranks, stacked tree or None)`` — the stack built
+        for scoring rides back to the caller when every reporter
+        survived, so the cohort's params cross to device ONCE per
+        round, not once for scoring and again for aggregation.
+        Quarantined reporters are scored (they can earn their way
+        back) but excluded. Skipped entirely on the zero-defense path
+        (mean rule, no quarantine, metrics off), which therefore pays
+        nothing."""
+        ranks = sorted(results)
+        m = telemetry.METRICS
+        score_now = self._quarantine.enabled() or (
+            self._pipeline.method != "mean" and m.enabled
+        )
+        if not score_now or not ranks:
+            return ranks, None
+        stacked_all = T.tree_stack([results[r][0] for r in ranks])
+        diag = self._diagnose(stacked_all)
+        events = self._reputation.observe(closed_idx, ranks,
+                                          diag["score"])
+        excluded = [r for r in ranks
+                    if self._reputation.is_quarantined(r)]
+        included = [r for r in ranks if r not in excluded]
+        if not included:
+            # every reporter is quarantined: refusing to aggregate
+            # would stall the run forever — degrade to the full set
+            # and let the per-round defense rule carry the round
+            telemetry.RECORDER.record(
+                "quarantine_overruled", round=closed_idx, ranks=ranks
+            )
+            included, excluded = ranks, []
+        if m.enabled:
+            if events["suspected"]:
+                m.inc("defense.suspected", len(events["suspected"]))
+            if events["quarantined"]:
+                m.inc("defense.quarantines", len(events["quarantined"]))
+            if events["released"]:
+                m.inc("defense.releases", len(events["released"]))
+            if excluded:
+                m.inc("defense.excluded", len(excluded))
+            sel_excluded = self._pipeline.excluded_count(len(included))
+            if sel_excluded:
+                # results the krum-family selection rule drops inside
+                # the aggregation pass by construction
+                m.inc("defense.excluded", sel_excluded)
+            if self._pipeline.method == "fltrust":
+                m.inc("defense.reweighted", len(included))
+            m.gauge("defense.quarantined",
+                    len(self._reputation.quarantined()))
+            m.gauge("defense.anomaly_score_max",
+                    float(diag["score"].max()))
+            for r in ranks:
+                m.gauge(f"defense.score_rank{r}",
+                        self._reputation.score(r))
+        if events["released"]:
+            telemetry.RECORDER.record(
+                "quarantine_released", round=closed_idx,
+                peers=events["released"],
+            )
+        if events["quarantined"]:
+            # a quarantine trip is a flight-recorder trigger, like a
+            # dead peer: the artifact names the peers and their scores
+            telemetry.RECORDER.record(
+                "quarantine", round=closed_idx,
+                peers=events["quarantined"],
+            )
+            telemetry.flight_dump(
+                "quarantine", round=closed_idx,
+                peers=events["quarantined"],
+                scores={r: self._reputation.score(r) for r in ranks},
+                quarantined=self._reputation.quarantined(),
+            )
+        return included, (stacked_all if included == ranks else None)
+
     def _close_round(
         self,
         results: dict[int, tuple[dict, float]],
@@ -681,10 +826,10 @@ class FedAvgServerActor(ServerManager):
             "round_close", round=closed_idx, results=len(results),
             dead_peers=dead if dead is not None else [],
         )
-        stacked = T.tree_stack(
-            [results[r][0] for r in sorted(results)]
-        )
-        weights = jnp.asarray([results[r][1] for r in sorted(results)])
+        included, stacked = self._score_and_exclude(results, closed_idx)
+        if stacked is None:
+            stacked = T.tree_stack([results[r][0] for r in included])
+        weights = jnp.asarray([results[r][1] for r in included])
         rkey = RND.round_key(self.root_key, self.state.round)
         self.state = server_update(
             self.cfg.fed,
@@ -703,9 +848,13 @@ class FedAvgServerActor(ServerManager):
         ):
             # atomic orbax save of the FULL ServerState — variables,
             # server-optimizer state, momentum, and the round counter
-            # every RNG fold derives from — keyed by the closed round,
-            # so a SIGKILLed server restarts from here, not round 0
-            self._ckpt.save(closed_idx, self.state)
+            # every RNG fold derives from — plus the reputation plane
+            # (quarantine must survive a server SIGKILL), keyed by the
+            # closed round, so a restart resumes here, not round 0
+            self._ckpt.save(closed_idx, {
+                "server": self.state,
+                "reputation": self._reputation.state_arrays(),
+            })
             telemetry.METRICS.inc("recovery.checkpoints")
             telemetry.RECORDER.record("checkpoint", round=closed_idx)
             # counters ride the checkpoint cadence to disk: a SIGKILLed
@@ -750,6 +899,16 @@ class FedAvgClientActor(ClientManager):
             build_local_update(model, task, cfg.train, batch, max_n)
         )
         self.root_key = jax.random.key(cfg.seed)
+        # seeded Byzantine injection (core/adversary.py): when THIS
+        # rank is a policy member it corrupts its own delta before
+        # sending — the deploy-path mirror of the simulator's stacked
+        # injection (docs/FAULT_TOLERANCE.md "Threat model")
+        adv = cfg.adversary
+        self._adversary = (
+            adv
+            if adv.enabled() and adv.is_member(rank, size - 1, base=1)
+            else None
+        )
         self.register_message_receive_handler(
             MSG_TYPE_S2C_SYNC_MODEL, self._handle_sync
         )
@@ -781,6 +940,12 @@ class FedAvgClientActor(ClientManager):
                 self.arrays.y,
                 rng,
             )
+            if self._adversary is not None:
+                new_vars = A.corrupt_client_vars(
+                    self._adversary, variables, new_vars, round_idx,
+                    self.rank,
+                )
+                telemetry.METRICS.inc("adversary.corrupted_results")
             host_vars = jax.tree.map(np.asarray, new_vars)
         self.send_message(
             Message(

@@ -30,8 +30,9 @@ import jax.numpy as jnp
 import optax
 
 from fedml_tpu.config import ExperimentConfig, FedConfig, TrainConfig
+from fedml_tpu.core import adversary as A
 from fedml_tpu.core import random as R
-from fedml_tpu.core import robust, tree as T
+from fedml_tpu.core import robust, telemetry, tree as T
 from fedml_tpu.data.federated import FederatedArrays, FederatedData, arrays_and_batch
 from fedml_tpu.algorithms.base import (
     build_cohort_local_update,
@@ -44,6 +45,22 @@ from fedml_tpu.algorithms.base import (
 from fedml_tpu.models.base import FedModel
 
 Pytree = Any
+
+
+def consume_round_counters(train_metrics: dict) -> dict:
+    """Pop device-computed counter values out of a round's metric dict
+    and feed them to the process metrics registry (the round loops —
+    :meth:`FedAvgSim.run` and the harness — call this where they
+    already force the metrics to host, so the bench's sync-free
+    ``run_round`` loop pays nothing)."""
+    rej = train_metrics.pop("nonfinite_rejected", None)
+    if rej is not None:
+        r = float(rej)
+        if r:
+            telemetry.METRICS.inc("robust.nonfinite_rejected", r)
+            telemetry.RECORDER.record("nonfinite_rejected", count=r,
+                                      path="sim")
+    return train_metrics
 
 
 class ServerState(NamedTuple):
@@ -121,9 +138,15 @@ def server_update(
         lambda s, g: s - g[None], stacked_vars["params"], global_params
     )
 
-    if fed.robust_norm_clip > 0:
-        deltas = robust.clip_deltas_by_norm(deltas, fed.robust_norm_clip)
+    # the full defense stack (core/robust.py): clip each delta, reduce
+    # under the configured rule (mean/median/trimmed_mean/krum/
+    # multikrum/fltrust), then noise the aggregate. The default
+    # pipeline (mean, clip 0, noise 0) is byte-identical to the plain
+    # weighted mean.
+    pipe = robust.DefensePipeline.from_fed(fed)
+    deltas = pipe.preprocess(deltas)
 
+    robust.check_fednova_compat(fed.algorithm, pipe.method)
     if fed.algorithm == "fednova":
         # tau_k = true local steps (real-first batch ordering makes this
         # exact); d_k = delta_k / tau_k; delta = tau_eff * sum p_k d_k
@@ -137,17 +160,10 @@ def server_update(
             lambda v: v / tau.reshape((-1,) + (1,) * (v.ndim - 1)), deltas
         )
         agg_delta = T.tree_scale(red.wmean(d, n_k), tau_eff)
-    elif fed.robust_method == "median":
-        agg_delta = robust.coordinate_median(red.gather(deltas))
-    elif fed.robust_method == "trimmed_mean":
-        agg_delta = robust.trimmed_mean(red.gather(deltas))
     else:
-        agg_delta = red.wmean(deltas, n_k)
+        agg_delta = pipe.reduce(deltas, n_k, red)
 
-    if fed.robust_noise_stddev > 0:
-        agg_delta = robust.add_gaussian_noise(
-            agg_delta, fed.robust_noise_stddev, jax.random.fold_in(rkey, 1)
-        )
+    agg_delta = pipe.postprocess(agg_delta, jax.random.fold_in(rkey, 1))
 
     # global momentum buffer (FedNova gmf; reference fednova.py gmf option)
     if fed.gmf > 0:
@@ -232,6 +248,9 @@ class FedAvgSim:
         self.sampler = sampler or R.sample_clients
         self.model = model
         self.cfg = cfg
+        # surfaced at construction instead of the first traced round
+        robust.check_fednova_compat(cfg.fed.algorithm,
+                                    cfg.fed.robust_method)
         self.task = make_task(data.task)
         self._prepare_data(data, cfg)
         max_n = self.arrays.max_client_samples
@@ -286,11 +305,13 @@ class FedAvgSim:
     # -- one round ---------------------------------------------------------
     def _locals(self, state: ServerState, arrays: FederatedArrays):
         """Sampling + local updates, the pre-aggregation prefix of the
-        round: returns (stacked_vars, n_k, metric sums, round key). Shared
-        with aggregation rules that live outside the compiled round (e.g.
-        TurboAggregate secure aggregation,
+        round: returns (stacked_vars, n_k, metric sums, round key,
+        cohort). Shared with aggregation rules that live outside the
+        compiled round (e.g. TurboAggregate secure aggregation,
         :class:`fedml_tpu.algorithms.mpc.SecureFedAvgSim`) so alternate
-        servers cannot drift from the canonical sampling/local math."""
+        servers cannot drift from the canonical sampling/local math.
+        The sampled cohort rides the return value so consumers (the
+        adversary injection gate) never re-derive the draw."""
         cfg = self.cfg.fed
         rkey = R.round_key(self.root_key, state.round)
         cohort = self.sampler(
@@ -317,11 +338,65 @@ class FedAvgSim:
             stacked_vars, n_k, msums = jax.vmap(
                 self.local_update, in_axes=(None, 0, 0, None, None, 0)
             )(state.variables, idx_rows, mask_rows, arrays.x, arrays.y, ckeys)
-        return stacked_vars, n_k, msums, rkey
+        return stacked_vars, n_k, msums, rkey, cohort
+
+    def _inject_adversaries(self, state, arrays, stacked_vars, cohort):
+        """Seeded Byzantine injection (core/adversary.py): adversarial
+        cohort slots get their params replaced by ``global + attacked
+        delta``; honest slots keep their EXACT local-update output (the
+        select happens at the variables level, so no honest value is
+        rewritten through a subtract/add round trip). ``cohort`` is the
+        draw `_locals` actually used — never re-derived."""
+        adv = self.cfg.adversary
+        mask = A.cohort_mask(adv, cohort, arrays.num_clients)
+        gp = state.variables["params"]
+        deltas = jax.tree.map(
+            lambda s, g: s - g[None], stacked_vars["params"], gp
+        )
+        attacked = A.corrupt_stacked_deltas(adv, deltas, state.round)
+        params = jax.tree.map(
+            lambda s, g, a: jnp.where(
+                mask.reshape((-1,) + (1,) * (s.ndim - 1)),
+                (g[None] + a).astype(s.dtype),
+                s,
+            ),
+            stacked_vars["params"], gp, attacked,
+        )
+        return {**stacked_vars, "params": params}
+
+    def _screen_nonfinite(self, state, stacked_vars, n_k):
+        """NaN/Inf screening on the simulator path — the same contract
+        as the deploy-path message handler (``_result_is_finite``): a
+        poisoned result must never enter the aggregate. Static shapes
+        cannot drop a row, so a screened client is replaced by the
+        global model (delta exactly 0 — a neutral no-op vote for the
+        coordinate defenses) with zero aggregation weight. All-finite
+        cohorts pass through byte-identically (``where(True, x, _) is
+        x`` value-wise)."""
+        ok = robust.finite_client_mask(stacked_vars, n_k)
+
+        def heal(s, g):
+            m = ok.reshape((-1,) + (1,) * (s.ndim - 1))
+            return jnp.where(m, s, g[None].astype(s.dtype))
+
+        cleaned = jax.tree.map(heal, stacked_vars, state.variables)
+        n_k = jnp.where(ok, n_k, jnp.zeros_like(n_k))
+        rejected = (ok.shape[0] - jnp.sum(ok)).astype(jnp.float32)
+        return cleaned, n_k, rejected
 
     def _round(self, state: ServerState, arrays: FederatedArrays):
         cfg = self.cfg.fed
-        stacked_vars, n_k, msums, rkey = self._locals(state, arrays)
+        stacked_vars, n_k, msums, rkey, cohort = self._locals(
+            state, arrays
+        )
+
+        if self.cfg.adversary.enabled():
+            stacked_vars = self._inject_adversaries(
+                state, arrays, stacked_vars, cohort
+            )
+        stacked_vars, n_k, rejected = self._screen_nonfinite(
+            state, stacked_vars, n_k
+        )
 
         new_state = server_update(
             cfg,
@@ -339,6 +414,10 @@ class FedAvgSim:
         train_metrics = {
             "train_loss": fin["loss"],
             "train_acc": fin["acc"],
+            # LAST so rate_bench's first-value sync stays train_loss;
+            # consumed host-side by consume_round_counters (the
+            # robust.nonfinite_rejected counter)
+            "nonfinite_rejected": rejected,
         }
         return new_state, train_metrics
 
@@ -362,6 +441,7 @@ class FedAvgSim:
         state = self.init()
         for r in range(self.cfg.fed.num_rounds):
             state, train_m = self.run_round(state)
+            train_m = consume_round_counters(dict(train_m))
             record = {"round": r, **{k: float(v) for k, v in train_m.items()}}
             if (r + 1) % self.cfg.fed.eval_every == 0 or (
                 r == self.cfg.fed.num_rounds - 1

@@ -15,6 +15,8 @@ import dataclasses
 import json
 from typing import Any, Mapping, Sequence
 
+from fedml_tpu.core.adversary import AdversaryPolicy
+
 
 @dataclasses.dataclass(frozen=True)
 class DataConfig:
@@ -106,10 +108,19 @@ class FedConfig:
     server_optimizer: str = "sgd"
     server_lr: float = 1.0
     server_momentum: float = 0.0
-    # robust aggregation (reference fedml_core/robustness/robust_aggregation.py)
+    # robust aggregation (reference fedml_core/robustness/robust_aggregation.py
+    # plus the Byzantine selection/scoring family, core/robust.py):
+    # "mean" | "median" | "trimmed_mean" | "krum" | "multikrum" | "fltrust"
     robust_norm_clip: float = 0.0  # 0 disables norm-diff clipping
     robust_noise_stddev: float = 0.0  # weak-DP gaussian noise
-    robust_method: str = "mean"  # "mean" | "median" (coordinate-wise)
+    robust_method: str = "mean"
+    # assumed adversary count f for the Krum family (selection keeps the
+    # C - f - 2 nearest neighbors per score)
+    robust_num_adversaries: int = 0
+    # multi-Krum keep count m (0 = auto: C - f)
+    robust_multikrum_m: int = 0
+    # trimmed-mean per-side trim fraction
+    robust_trim_frac: float = 0.1
     # FedNova normalized averaging
     gmf: float = 0.0  # global momentum factor
 
@@ -171,6 +182,12 @@ class ExperimentConfig:
     fed: FedConfig = dataclasses.field(default_factory=FedConfig)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     gan: GanConfig = dataclasses.field(default_factory=GanConfig)
+    # seeded Byzantine adversary injection (core/adversary.py): which
+    # clients emit malicious deltas, and how. Disabled by default; the
+    # defense side lives in FedConfig.robust_*.
+    adversary: AdversaryPolicy = dataclasses.field(
+        default_factory=AdversaryPolicy
+    )
     seed: int = 0
     run_name: str = "run"
     out_dir: str = "./runs"
@@ -210,6 +227,10 @@ class ExperimentConfig:
                     )
                 if k == "input_shape" and isinstance(v, Sequence):
                     v = tuple(v)
+                if k == "ranks" and isinstance(v, Sequence):
+                    # json round-trips the adversary rank tuple as a
+                    # list; restore for hashability under jit
+                    v = tuple(int(r) for r in v)
                 kw[k] = v
             return cls(**kw)
 
@@ -220,6 +241,7 @@ class ExperimentConfig:
             fed=build(FedConfig, d.get("fed")),
             mesh=build(MeshConfig, d.get("mesh")),
             gan=build(GanConfig, d.get("gan")),
+            adversary=build(AdversaryPolicy, d.get("adversary")),
             seed=d.get("seed", 0),
             run_name=d.get("run_name", "run"),
             out_dir=d.get("out_dir", "./runs"),

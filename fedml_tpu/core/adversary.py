@@ -1,0 +1,239 @@
+"""Byzantine adversary injection: seeded, deterministic malicious deltas.
+
+The fault layer (:mod:`fedml_tpu.core.transport.chaos`) models *benign*
+unreliability — drops, delays, crashes. This module models *malice*: a
+selected subset of clients emits corrupted model deltas instead of the
+honest local-update result. Following FedJAX's simulation-fidelity
+argument (arxiv 2108.02117), attacks are pure, seeded functions over
+client deltas, so an adversarial round is exactly reproducible given
+``(policy, round)`` — on the compiled simulator (vectorized over the
+stacked ``[C, ...]`` cohort) and on the deployment path (each malicious
+rank corrupts its own delta before sending) alike.
+
+Attack modes (``d`` = the honest delta ``new_params - global_params``):
+
+- **sign_flip**   — ``d' = -scale * d`` (gradient-ascent steering).
+- **scale_boost** — ``d' = scale * d`` (honest direction, boosted to
+  dominate the weighted mean).
+- **gauss**       — ``d' = d + noise_stddev * N(0, 1)`` (stochastic
+  poisoning / unusable-update attack).
+- **zero**        — ``d' = 0`` (free-riding: claims sample mass while
+  contributing nothing).
+- **constant**    — ``d' = scale * ones`` (coordinate-bias attack).
+- **collude**     — every adversary emits the SAME pseudo-delta, drawn
+  from the policy seed folded with the round (global L2 norm
+  ``scale``). Colluders are indistinguishable from each other by
+  construction — the attack that defeats distance-based selection
+  (Krum picks the tight colluding cluster) and is instead caught by
+  the near-duplicate anomaly signal (:func:`fedml_tpu.core.robust.
+  anomaly_scores`).
+
+Reproducibility contract: the corruption stream is a deterministic
+function of ``(seed, mode, round, member identity)``. The simulator and
+the deployment path each replay byte-identically under a fixed seed;
+the two paths are not bit-equal to each other (they stack and fold keys
+differently), matching the chaos layer's per-path replayability.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.core import tree as T
+
+Pytree = Any
+
+#: fold_in salt separating the adversary key stream from training keys
+_ADV_SALT = 0x41D5
+
+MODES = ("sign_flip", "scale_boost", "gauss", "zero", "constant",
+         "collude")
+
+
+@dataclasses.dataclass(frozen=True)
+class AdversaryPolicy:
+    """Which clients are malicious and how (sibling of
+    :class:`~fedml_tpu.core.transport.chaos.FaultPolicy` — frozen and
+    hashable, so it rides :class:`~fedml_tpu.config.ExperimentConfig`
+    as a jit-static field).
+
+    - ``mode``: one of :data:`MODES`, or ``"none"`` (disabled).
+    - ``ranks``: explicit adversary identities — CLIENT ids on the
+      simulator path, worker RANKS (>= 1) on the deployment path.
+    - ``num_adversaries``: when ``ranks`` is empty, a seeded choice of
+      this many members from the population.
+    - ``scale``: magnitude knob (sign_flip/scale_boost multiplier,
+      constant fill value, collude pseudo-delta norm).
+    - ``noise_stddev``: gauss-mode perturbation stddev.
+    """
+
+    seed: int = 0
+    mode: str = "none"
+    ranks: tuple[int, ...] = ()
+    num_adversaries: int = 0
+    scale: float = 10.0
+    noise_stddev: float = 1.0
+
+    def __post_init__(self):
+        if self.mode not in ("none", *MODES):
+            raise ValueError(
+                f"adversary mode must be one of {('none', *MODES)}, "
+                f"got {self.mode!r}"
+            )
+        if self.num_adversaries < 0:
+            raise ValueError(
+                f"num_adversaries must be >= 0, "
+                f"got {self.num_adversaries}"
+            )
+
+    def enabled(self) -> bool:
+        return self.mode != "none" and bool(
+            self.ranks or self.num_adversaries
+        )
+
+    def member_ids(self, population: int, base: int = 0) -> np.ndarray:
+        """The adversarial identities among ``[base, base+population)``:
+        the explicit ``ranks`` when given (validated in range), else a
+        seeded without-replacement choice of ``num_adversaries``. Host-
+        side and deterministic — callers close over the result as a
+        constant under jit."""
+        if not self.enabled():
+            return np.zeros((0,), np.int32)
+        if self.ranks:
+            ids = np.asarray(sorted(set(self.ranks)), np.int32)
+            lo, hi = base, base + population
+            if ids.size and (ids[0] < lo or ids[-1] >= hi):
+                raise ValueError(
+                    f"adversary ranks {sorted(set(self.ranks))} outside "
+                    f"the population [{lo}, {hi})"
+                )
+            return ids
+        n = min(self.num_adversaries, population)
+        rng = np.random.default_rng(self.seed)
+        ids = rng.choice(population, size=n, replace=False) + base
+        return np.sort(ids).astype(np.int32)
+
+    def is_member(self, ident: int, population: int,
+                  base: int = 0) -> bool:
+        return bool(np.isin(ident, self.member_ids(population, base)))
+
+
+def _round_key(policy: AdversaryPolicy, round_idx) -> jax.Array:
+    """The shared per-round adversary key — every colluder can derive
+    it independently (it depends only on the policy seed + round)."""
+    return jax.random.fold_in(
+        jax.random.fold_in(jax.random.key(policy.seed), _ADV_SALT),
+        round_idx,
+    )
+
+
+def _leaf_keys(key: jax.Array, tree_: Pytree) -> Pytree:
+    leaves, treedef = jax.tree.flatten(tree_)
+    return jax.tree.unflatten(
+        treedef, list(jax.random.split(key, max(1, len(leaves))))
+    )
+
+
+def collusion_delta(policy: AdversaryPolicy, like: Pytree,
+                    round_idx) -> Pytree:
+    """The shared colluding pseudo-delta: a seeded gaussian direction
+    normalized to global L2 norm ``policy.scale``, shaped like one
+    client's delta (``like``). Identical for every colluder in a round
+    because it derives only from ``(seed, round, shapes)``."""
+    keys = _leaf_keys(_round_key(policy, round_idx), like)
+    raw = jax.tree.map(
+        lambda l, k: jax.random.normal(k, l.shape, jnp.float32),
+        like, keys,
+    )
+    norm = T.tree_l2_norm(raw)
+    s = jnp.asarray(policy.scale, jnp.float32) / jnp.maximum(norm, 1e-12)
+    return jax.tree.map(
+        lambda r, l: (r * s).astype(l.dtype), raw, like
+    )
+
+
+def _attack_one(policy: AdversaryPolicy, delta: Pytree, key: jax.Array,
+                round_idx) -> Pytree:
+    """Apply ``policy.mode`` to ONE client's delta. Pure; preserves
+    leaf dtypes."""
+    mode = policy.mode
+    if mode == "sign_flip":
+        return jax.tree.map(
+            lambda d: d * jnp.asarray(-policy.scale, d.dtype), delta
+        )
+    if mode == "scale_boost":
+        return jax.tree.map(
+            lambda d: d * jnp.asarray(policy.scale, d.dtype), delta
+        )
+    if mode == "zero":
+        return jax.tree.map(jnp.zeros_like, delta)
+    if mode == "constant":
+        return jax.tree.map(
+            lambda d: jnp.full_like(d, policy.scale), delta
+        )
+    if mode == "gauss":
+        keys = _leaf_keys(key, delta)
+        return jax.tree.map(
+            lambda d, k: d + (
+                policy.noise_stddev
+                * jax.random.normal(k, d.shape, jnp.float32)
+            ).astype(d.dtype),
+            delta, keys,
+        )
+    if mode == "collude":
+        return collusion_delta(policy, delta, round_idx)
+    raise ValueError(f"unknown adversary mode: {mode!r}")
+
+
+def corrupt_stacked_deltas(policy: AdversaryPolicy, stacked: Pytree,
+                           round_idx) -> Pytree:
+    """Simulator path: return the ATTACKED version of every row of a
+    stacked ``[C, ...]`` delta pytree (one vectorized op — the caller
+    selects adversarial rows with its cohort mask, so honest rows stay
+    byte-identical to the untouched input). Jit-traceable; ``round_idx``
+    may be a traced scalar."""
+    if policy.mode == "collude":
+        # one shared pseudo-delta, broadcast over the cohort axis
+        like = jax.tree.map(lambda x: x[0], stacked)
+        base = collusion_delta(policy, like, round_idx)
+        return jax.tree.map(
+            lambda x, b: jnp.broadcast_to(b[None], x.shape), stacked,
+            base,
+        )
+    return _attack_one(
+        policy, stacked, _round_key(policy, round_idx), round_idx
+    )
+
+
+def cohort_mask(policy: AdversaryPolicy, cohort: jax.Array,
+                num_clients: int) -> jax.Array:
+    """``[C]`` bool: which sampled cohort slots belong to adversarial
+    CLIENT ids. ``cohort`` may be traced; the member-id set is a static
+    host-side constant."""
+    ids = policy.member_ids(num_clients)
+    if ids.size == 0:
+        return jnp.zeros(cohort.shape, bool)
+    return jnp.isin(cohort, jnp.asarray(ids))
+
+
+def corrupt_client_vars(policy: AdversaryPolicy, global_vars: dict,
+                        new_vars: dict, round_idx: int,
+                        member: int) -> dict:
+    """Deployment path: a malicious rank corrupts its OWN result before
+    sending — ``params' = global + attack(params - global)``. Non-param
+    collections (batch_stats) ride unmodified, mirroring the simulator
+    injection. ``member`` (the rank) decorrelates gauss draws between
+    adversaries; collude ignores it by design."""
+    g = global_vars["params"]
+    delta = jax.tree.map(jnp.subtract, new_vars["params"], g)
+    key = jax.random.fold_in(_round_key(policy, round_idx), member)
+    attacked = _attack_one(policy, delta, key, round_idx)
+    params = jax.tree.map(
+        lambda gg, d: (gg + d).astype(gg.dtype), g, attacked
+    )
+    return {**new_vars, "params": params}

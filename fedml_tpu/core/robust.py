@@ -5,10 +5,34 @@ TPU-native redesign of the reference ``RobustAggregator``
 weak-DP gaussian noise, and coordinate-wise median. The reference applies
 these per-client in Python; here each defense is one vectorized op over the
 stacked ``[C, ...]`` delta pytree so it fuses into the aggregation pass.
+
+Beyond the reference's coordinate-wise defenses, this module carries the
+*selection/scoring* family used against actively malicious clients
+(:mod:`fedml_tpu.core.adversary` injects them deterministically):
+
+- **Krum / multi-Krum** (Blanchard et al., NeurIPS'17) — pairwise-
+  distance selection; the ``[C, C]`` distance matrix is one matmul over
+  the flattened deltas so it fuses on TPU.
+- **FLTrust-style cosine trust weighting** (Cao et al., NDSS'21) — each
+  delta is reweighted by its ReLU'd cosine similarity to a server
+  reference delta and norm-matched to it. Without a server root
+  dataset the reference defaults to the coordinate-median of the
+  cohort's deltas (itself a robust statistic).
+- **Anomaly scores** — per-client L2-norm z-score, cosine to the
+  mean/median delta, and a near-duplicate (collusion) signal; the
+  cross-round reputation plane (``fedml_tpu.core.reputation``)
+  accumulates these.
+
+:class:`DefensePipeline` assembles the families into the single
+aggregation-rule hook both round programs share
+(:func:`fedml_tpu.algorithms.fedavg.server_update`). Its ``mean``
+configuration with clip/noise off is byte-identical to the plain
+weighted mean — the defense plane is invisible until switched on.
 """
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Any
 
 import jax
@@ -21,12 +45,25 @@ Pytree = Any
 
 def clip_deltas_by_norm(stacked_deltas: Pytree, clip: float) -> Pytree:
     """Scale each client's delta to at most L2 norm ``clip`` (reference
-    ``norm_diff_clipping``, ``robust_aggregation.py:38-49``)."""
+    ``norm_diff_clipping``, ``robust_aggregation.py:38-49``).
+
+    Dtype-preserving: the scale is computed in f32 but each leaf is cast
+    back to its own dtype (a bf16 leaf under mixed precision used to
+    silently upcast the whole stacked tree to f32). Zero-size leaves
+    (and leafless trees) pass through untouched — ``vmap`` over an
+    empty tree cannot infer a batch size."""
+    if not jax.tree.leaves(stacked_deltas):
+        return stacked_deltas
     norms = jax.vmap(T.tree_l2_norm)(stacked_deltas)  # [C]
     scale = jnp.minimum(1.0, clip / jnp.maximum(norms, 1e-12))
-    return jax.tree.map(
-        lambda x: x * scale.reshape((-1,) + (1,) * (x.ndim - 1)), stacked_deltas
-    )
+
+    def leaf(x):
+        if x.size == 0:
+            return x
+        s = scale.reshape((-1,) + (1,) * (x.ndim - 1))
+        return (x.astype(jnp.float32) * s).astype(x.dtype)
+
+    return jax.tree.map(leaf, stacked_deltas)
 
 
 def add_gaussian_noise(tree_: Pytree, stddev: float, rng: jax.Array) -> Pytree:
@@ -64,3 +101,327 @@ def trimmed_mean(stacked: Pytree, trim_frac: float = 0.1) -> Pytree:
         return jnp.mean(s[k : c - k], axis=0)
 
     return jax.tree.map(leaf, stacked)
+
+
+# ---------------------------------------------------------------------------
+# selection / scoring defenses
+# ---------------------------------------------------------------------------
+
+
+def flatten_clients(stacked: Pytree) -> jax.Array:
+    """``[C, D]`` f32 matrix of flattened client deltas — the shared
+    substrate of every distance/cosine defense (one gather, then every
+    score is a matmul or row reduction that fuses on TPU)."""
+    x = jax.vmap(T.tree_vectorize)(stacked)
+    return x.astype(jnp.float32)
+
+
+def pairwise_sq_dists(stacked: Pytree) -> jax.Array:
+    """``[C, C]`` squared L2 distances between client deltas, computed
+    as ONE gram matmul over the flattened ``[C, D]`` deltas (never a
+    python double loop): ``d2_ij = |x_i|^2 + |x_j|^2 - 2 x_i.x_j``."""
+    x = flatten_clients(stacked)
+    sq = jnp.sum(x * x, axis=1)
+    d2 = sq[:, None] + sq[None, :] - 2.0 * (x @ x.T)
+    d2 = jnp.maximum(d2, 0.0)  # float error can dip negative
+    c = x.shape[0]
+    return d2 * (1.0 - jnp.eye(c, dtype=d2.dtype))  # exact-zero diagonal
+
+
+#: large-but-finite stand-in for "not a neighbor" in the Krum scores —
+#: summing a handful of these stays representable in f32 where a true
+#: inf would flatten every score to inf and make argmin arbitrary
+_FAR = 1e30
+
+
+def krum_scores(d2: jax.Array, num_adversaries: int,
+                valid: jax.Array | None = None) -> jax.Array:
+    """Krum score per client: the sum of its ``C - f - 2`` smallest
+    distances to OTHER clients (Blanchard et al.; lower = more central).
+    Degenerate cohorts (``C <= f + 2``) fall back to the single nearest
+    neighbor so the selection stays defined. ``valid`` (``[C]`` bool)
+    marks rows eligible for selection: zero-weight rows — e.g. the
+    non-finite screen's healed zero deltas — would otherwise form an
+    exact-zero-distance cluster that Krum scores as maximally central
+    (a screening-induced DoS on the selection defenses), so distances
+    to and from invalid rows count as :data:`_FAR`, pushing them to
+    the bottom of every ranking while valid rows still order by their
+    real neighborhoods."""
+    c = d2.shape[0]
+    k = max(1, min(c - 2 - num_adversaries, c - 1))
+    if valid is not None:
+        pair_ok = valid[:, None] & valid[None, :]
+        pair_ok = pair_ok | jnp.eye(c, dtype=bool)  # keep self 0
+        d2 = jnp.where(pair_ok, d2, _FAR)
+    s = jnp.sort(d2, axis=1)  # column 0 is the exact-zero self distance
+    return jnp.sum(s[:, 1 : k + 1], axis=1)
+
+
+def krum(stacked: Pytree, num_adversaries: int,
+         weights: jax.Array | None = None
+         ) -> tuple[Pytree, jax.Array, jax.Array]:
+    """Krum selection: return ``(selected delta, scores, best index)``
+    — the single most central client's delta IS the aggregate. Rows
+    with zero ``weights`` are never selected."""
+    valid = None if weights is None else weights > 0
+    scores = krum_scores(pairwise_sq_dists(stacked), num_adversaries,
+                         valid)
+    best = jnp.argmin(scores)
+    return jax.tree.map(lambda x: x[best], stacked), scores, best
+
+
+def multi_krum(stacked: Pytree, weights: jax.Array, num_adversaries: int,
+               m: int = 0) -> tuple[Pytree, jax.Array, jax.Array]:
+    """Multi-Krum: weighted mean over the ``m`` best-scored clients
+    (``m = 0`` auto-resolves to ``C - f``, clamped to ``[1, C]``).
+    Returns ``(aggregate, scores, selected mask)``. Zero-weight rows
+    rank last and contribute nothing even if the keep count reaches
+    them (their aggregation weight is already 0)."""
+    c = jax.tree.leaves(stacked)[0].shape[0]
+    f = num_adversaries
+    m_eff = m if m > 0 else max(1, c - f)
+    m_eff = max(1, min(m_eff, c))
+    scores = krum_scores(pairwise_sq_dists(stacked), f, weights > 0)
+    _, idx = jax.lax.top_k(-scores, m_eff)
+    mask = jnp.zeros((c,), bool).at[idx].set(True)
+    w = jnp.where(mask, weights.astype(jnp.float32), 0.0)
+    return T.tree_weighted_mean(stacked, w), scores, mask
+
+
+def fltrust(stacked: Pytree, ref: Pytree, eps: float = 1e-12,
+            weights: jax.Array | None = None
+            ) -> tuple[Pytree, jax.Array]:
+    """FLTrust-style trust-weighted aggregation against a server
+    reference delta ``ref``: trust ``t_i = relu(cos(d_i, ref))``, each
+    delta norm-matched to ``|ref|``, aggregate = trust-weighted mean.
+    When every trust score is zero (the whole cohort points away from
+    the reference) the aggregate degrades to ``ref`` itself rather than
+    dividing by zero. Rows with zero ``weights`` (screened results)
+    get zero trust. Returns ``(aggregate, trust scores)``."""
+    x = flatten_clients(stacked)  # [C, D]
+    r = T.tree_vectorize(ref).astype(jnp.float32)  # [D]
+    rn = jnp.sqrt(jnp.sum(r * r))
+    xn = jnp.sqrt(jnp.sum(x * x, axis=1))  # [C]
+    cos = (x @ r) / jnp.maximum(xn * rn, eps)
+    trust = jax.nn.relu(cos)
+    if weights is not None:
+        trust = trust * (weights > 0)
+    norm_match = rn / jnp.maximum(xn, eps)  # [C]
+    w = trust / jnp.maximum(jnp.sum(trust), eps)
+    agg_vec = jnp.sum(x * (w * norm_match)[:, None], axis=0)
+    agg_vec = jnp.where(jnp.sum(trust) > 0, agg_vec, r)
+    return T.tree_unvectorize(agg_vec, ref), trust
+
+
+def anomaly_scores(stacked: Pytree) -> dict[str, jax.Array]:
+    """Per-client anomaly signals over a stacked delta tree, all
+    derived from one flatten + one gram matmul:
+
+    - ``l2_norm`` / ``l2_z``: delta norm and its cohort z-score (the
+      scale-boost signature);
+    - ``cos_to_mean`` / ``cos_to_med``: cosine to the cohort mean and
+      coordinate-median delta (sign-flip points away from the robust
+      center; the mean variant is reported but poisonable by a large
+      minority, so the combined score uses the median one);
+    - ``nearest_rel``: nearest-neighbor distance relative to the
+      client's own norm — near-zero means another client sent (almost)
+      the same delta, the colluding-copy signature honest data cannot
+      produce;
+    - ``score``: the combined scalar the reputation plane accumulates:
+      ``relu(l2_z) + relu(-cos_to_med) + 2 * near_duplicate``.
+    """
+    eps = 1e-12
+    x = flatten_clients(stacked)  # [C, D]
+    c = x.shape[0]
+    sq = jnp.sum(x * x, axis=1)
+    norms = jnp.sqrt(sq)
+    mu = jnp.mean(norms)
+    sd = jnp.std(norms)
+    l2_z = (norms - mu) / jnp.maximum(sd, 1e-6)
+
+    mean_vec = jnp.mean(x, axis=0)
+    med_vec = T.tree_vectorize(coordinate_median(stacked)).astype(
+        jnp.float32
+    )
+
+    def _cos(ref):
+        rn = jnp.sqrt(jnp.sum(ref * ref))
+        return (x @ ref) / jnp.maximum(norms * rn, eps)
+
+    gram = x @ x.T
+    d2 = jnp.maximum(sq[:, None] + sq[None, :] - 2.0 * gram, 0.0)
+    d2 = jnp.where(jnp.eye(c, dtype=bool), jnp.inf, d2)  # mask self
+    nearest = jnp.sqrt(jnp.min(d2, axis=1)) if c > 1 else jnp.full(
+        (c,), jnp.inf
+    )
+    nearest_rel = nearest / jnp.maximum(norms, eps)
+    dup = (nearest_rel < 1e-3).astype(jnp.float32)
+
+    cos_to_mean = _cos(mean_vec)
+    cos_to_med = _cos(med_vec)
+    score = (
+        jax.nn.relu(l2_z)
+        + jax.nn.relu(-cos_to_med)
+        + 2.0 * dup
+    )
+    return {
+        "l2_norm": norms,
+        "l2_z": l2_z,
+        "cos_to_mean": cos_to_mean,
+        "cos_to_med": cos_to_med,
+        "nearest_rel": nearest_rel,
+        "score": score,
+    }
+
+
+# ---------------------------------------------------------------------------
+# non-finite screening (shared with the deploy-path message handler)
+# ---------------------------------------------------------------------------
+
+
+def finite_client_mask(stacked: Pytree, n_k: jax.Array) -> jax.Array:
+    """``[C]`` bool: True where EVERY floating leaf of client ``c`` is
+    finite and its sample count is finite. Integer leaves are finite by
+    construction (mirrors ``_result_is_finite`` on the deploy path,
+    inside jit)."""
+    ok = jnp.isfinite(n_k.astype(jnp.float32))
+    for x in jax.tree.leaves(stacked):
+        if not jnp.issubdtype(x.dtype, jnp.floating):
+            continue
+        axes = tuple(range(1, x.ndim))
+        ok = ok & jnp.all(jnp.isfinite(x), axis=axes)
+    return ok
+
+
+def check_fednova_compat(algorithm: str, method: str) -> None:
+    """The single source of the fednova-vs-defense rule, raised early
+    by the CLI and both round-program constructors and as a backstop
+    inside ``server_update``: fednova's tau-normalized averaging IS
+    the aggregation rule, so a configured reduce defense would be
+    silently bypassed while the summary reports it in force."""
+    if algorithm == "fednova" and method not in ("mean", "", None):
+        raise ValueError(
+            f"robust_method={method!r} is incompatible with "
+            "algorithm='fednova' (tau-normalized averaging is the "
+            "aggregation rule); use fedavg/fedopt with a defense, or "
+            "keep fednova with robust_norm_clip/robust_noise_stddev "
+            "(which do compose)"
+        )
+
+
+# ---------------------------------------------------------------------------
+# the configurable pipeline
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class DefensePipeline:
+    """The composable defense stack applied inside the aggregation pass
+    (both round programs: the compiled simulator's ``server_update``
+    and the actor server's round close call the SAME instance shape).
+
+    Order — clip each delta, reduce with the configured rule, then
+    noise the aggregate::
+
+        preprocess  -> clip_deltas_by_norm        (clip > 0)
+        reduce      -> mean | median | trimmed_mean
+                       | krum | multikrum | fltrust
+        postprocess -> add_gaussian_noise          (noise_stddev > 0)
+
+    The default (``mean``, clip 0, noise 0) is byte-identical to the
+    plain weighted mean — the zero-defense path costs nothing."""
+
+    method: str = "mean"
+    clip: float = 0.0
+    noise_stddev: float = 0.0
+    num_adversaries: int = 0
+    multikrum_m: int = 0  # 0 = auto (C - f)
+    trim_frac: float = 0.1
+
+    METHODS = ("mean", "median", "trimmed_mean", "krum", "multikrum",
+               "fltrust")
+
+    def __post_init__(self):
+        if self.method not in self.METHODS:
+            raise ValueError(
+                f"defense method must be one of {self.METHODS}, "
+                f"got {self.method!r}"
+            )
+        if (self.method == "multikrum" and self.num_adversaries == 0
+                and self.multikrum_m == 0):
+            # auto m = C - f with f = 0 keeps every client: the plain
+            # weighted mean wearing a 'multikrum' label — reject the
+            # vacuous configuration instead of reporting a defense
+            # that is not in force
+            raise ValueError(
+                "multikrum with num_adversaries=0 and multikrum_m=0 "
+                "selects every client (plain mean); set "
+                "--defense_num_adversaries f (auto m = C - f) or an "
+                "explicit --defense_multikrum_m"
+            )
+
+    @staticmethod
+    def from_fed(fed) -> "DefensePipeline":
+        """Build from :class:`~fedml_tpu.config.FedConfig` robust_*
+        fields (the single CLI/config surface)."""
+        return DefensePipeline(
+            method=fed.robust_method or "mean",
+            clip=fed.robust_norm_clip,
+            noise_stddev=fed.robust_noise_stddev,
+            num_adversaries=fed.robust_num_adversaries,
+            multikrum_m=fed.robust_multikrum_m,
+            trim_frac=fed.robust_trim_frac,
+        )
+
+    def preprocess(self, deltas: Pytree) -> Pytree:
+        return (
+            clip_deltas_by_norm(deltas, self.clip)
+            if self.clip > 0 else deltas
+        )
+
+    def reduce(self, deltas: Pytree, weights: jax.Array, red) -> Pytree:
+        """Aggregate stacked deltas under the configured rule. ``red``
+        is the :class:`~fedml_tpu.algorithms.fedavg.Reducer` — selection
+        defenses gather the full ``[C, ...]`` stack (like the median
+        rule always has), so they compose with the mesh-sharded
+        runtime unchanged."""
+        if self.method == "mean":
+            return red.wmean(deltas, weights)
+        g = red.gather(deltas)
+        if self.method == "median":
+            return coordinate_median(g)
+        if self.method == "trimmed_mean":
+            return trimmed_mean(g, self.trim_frac)
+        gw = red.gather(weights)
+        if self.method == "krum":
+            return krum(g, self.num_adversaries, gw)[0]
+        if self.method == "multikrum":
+            return multi_krum(
+                g, gw, self.num_adversaries, self.multikrum_m
+            )[0]
+        if self.method == "fltrust":
+            # no server root dataset in the loop: the reference delta
+            # defaults to the coordinate-median of the cohort (robust
+            # to a minority of adversaries by construction)
+            return fltrust(g, coordinate_median(g), weights=gw)[0]
+        raise ValueError(f"unknown defense method: {self.method!r}")
+
+    def postprocess(self, agg: Pytree, rng: jax.Array) -> Pytree:
+        return (
+            add_gaussian_noise(agg, self.noise_stddev, rng)
+            if self.noise_stddev > 0 else agg
+        )
+
+    def excluded_count(self, cohort_size: int) -> int:
+        """How many of ``cohort_size`` results the reduce rule excludes
+        from the aggregate by construction (telemetry: the
+        ``defense.excluded`` counter). Reweighting rules (fltrust)
+        exclude nobody statically — they zero trust at runtime."""
+        if self.method == "krum":
+            return max(0, cohort_size - 1)
+        if self.method == "multikrum":
+            m = self.multikrum_m if self.multikrum_m > 0 else max(
+                1, cohort_size - self.num_adversaries
+            )
+            return max(0, cohort_size - min(m, cohort_size))
+        return 0

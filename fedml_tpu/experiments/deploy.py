@@ -106,6 +106,13 @@ class DeployConfig:
     recovery_extensions: int = 0
     # seeded fault injection for THIS rank (None/disabled = real traffic)
     fault: FaultPolicy | None = None
+    # -- Byzantine defense (docs/FAULT_TOLERANCE.md "Threat model") --------
+    # server rank: quarantine clients whose cross-round EWMA anomaly
+    # score exceeds the threshold (0 = off); they stay served but their
+    # results are excluded from aggregation, and the reputation state
+    # rides the round checkpoint so it survives server restarts
+    quarantine_threshold: float = 0.0
+    quarantine_decay: float = 0.7
     # -- telemetry (docs/OBSERVABILITY.md) ---------------------------------
     # directory for THIS rank's artifacts: trace_rank<r>.json span dump,
     # metrics_rank<r>.json snapshot, flight_rank<r>_*.json crash rings;
@@ -402,6 +409,8 @@ def _run_fedavg_rank(cfg: ExperimentConfig, dep: DeployConfig) -> dict:
             # uses, so a deploy run and a sim run of one config share
             # the resume story (docs/FAULT_TOLERANCE.md "Recovery")
             ckpt = RoundCheckpointer(os.path.join(_run_dir(cfg), "ckpt"))
+        from fedml_tpu.core.reputation import QuarantinePolicy
+
         server = FedAvgServerActor(
             dep.world_size, transport, model, cfg,
             num_clients=cfg.data.num_clients, data=data,
@@ -412,6 +421,10 @@ def _run_fedavg_rank(cfg: ExperimentConfig, dep: DeployConfig) -> dict:
             ),
             checkpointer=ckpt,
             checkpoint_every=dep.checkpoint_every or 1,
+            quarantine=QuarantinePolicy(
+                threshold=dep.quarantine_threshold,
+                decay=dep.quarantine_decay,
+            ),
         )
         try:
             if server.resumed_from >= cfg.fed.num_rounds:
@@ -461,6 +474,11 @@ def _run_fedavg_rank(cfg: ExperimentConfig, dep: DeployConfig) -> dict:
             "final_params": path,
             "params_digest": _params_digest(server.variables),
             "dead_peers": sorted(server.dead_peers),
+            # the Byzantine-defense plane's verdicts (docs/
+            # FAULT_TOLERANCE.md "Threat model"): the defense rule in
+            # force and which ranks ended the run quarantined
+            "defense": cfg.fed.robust_method,
+            "quarantined": server.quarantined_ranks,
             **metrics,
         }
 
@@ -495,6 +513,15 @@ def _run_splitnn_rank(cfg: ExperimentConfig, dep: DeployConfig) -> dict:
             "warning: --checkpoint_every is ignored for splitnn "
             "deployments (round checkpointing covers the fedavg "
             "family only)",
+            file=_sys.stderr,
+        )
+    if cfg.adversary.enabled():
+        import sys as _sys
+
+        print(
+            "warning: --adversary_* flags are ignored by splitnn "
+            "ranks (adversary injection covers the fedavg-family "
+            "client actor only)",
             file=_sys.stderr,
         )
     data = load_dataset(cfg.data)

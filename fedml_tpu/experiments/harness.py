@@ -426,6 +426,11 @@ class Experiment:
                 # any pre-crash row for the same round
                 record["resumed"] = True
             if isinstance(m, dict):
+                from fedml_tpu.algorithms.fedavg import (
+                    consume_round_counters,
+                )
+
+                m = consume_round_counters(dict(m))
                 record.update({k: _f(v) for k, v in m.items()
                                if _scalar(v)})
             if (r + 1) % cfg.fed.eval_every == 0 or (

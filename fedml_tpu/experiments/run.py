@@ -58,10 +58,63 @@ def parse_args(argv=None) -> tuple[ExperimentConfig, argparse.Namespace]:
     p.add_argument("--partition_method", type=str, default=None)
     p.add_argument("--partition_alpha", type=float, default=None)
     p.add_argument("--frequency_of_the_test", type=int, default=None)
+    _DEFENSES = ["mean", "median", "trimmed_mean", "krum", "multikrum",
+                 "fltrust"]
     p.add_argument("--robust_method", type=str, default=None,
-                   choices=["mean", "median", "trimmed_mean"])
+                   choices=_DEFENSES)
+    p.add_argument("--defense", type=str, default=None,
+                   choices=_DEFENSES,
+                   help="aggregation defense rule (alias of "
+                        "--robust_method, taking precedence; composes "
+                        "with --robust_norm_clip / "
+                        "--robust_noise_stddev — see "
+                        "docs/FAULT_TOLERANCE.md 'Threat model')")
+    p.add_argument("--defense_num_adversaries", type=int, default=None,
+                   help="assumed adversary count f for the Krum-family "
+                        "defenses (selection keeps the C-f-2 nearest "
+                        "neighbors per score)")
+    p.add_argument("--defense_multikrum_m", type=int, default=None,
+                   help="multi-Krum keep count m (0 = auto: C - f)")
+    p.add_argument("--defense_trim_frac", type=float, default=None,
+                   help="trimmed_mean per-side trim fraction (raise it "
+                        "for small cohorts: floor(0.1*C) trims nobody "
+                        "below C=10)")
     p.add_argument("--robust_norm_clip", type=float, default=None)
     p.add_argument("--robust_noise_stddev", type=float, default=None)
+    # -- seeded Byzantine adversary injection (core/adversary.py) ----------
+    p.add_argument("--adversary_mode", type=str, default=None,
+                   choices=["none", "sign_flip", "scale_boost", "gauss",
+                            "zero", "constant", "collude"],
+                   help="make selected clients emit malicious deltas "
+                        "(simulator: client ids; deployment: worker "
+                        "ranks). Deterministic given --adversary_seed")
+    p.add_argument("--adversary_seed", type=int, default=None,
+                   help="seed for the adversary stream (selection + "
+                        "corruption draws)")
+    p.add_argument("--adversary_ranks", type=int, nargs="+",
+                   default=None,
+                   help="explicit adversarial identities (client ids "
+                        "on the simulator path, ranks >= 1 under "
+                        "--role); overrides --adversary_num")
+    p.add_argument("--adversary_num", type=int, default=None,
+                   help="seeded choice of this many adversaries when "
+                        "--adversary_ranks is not given")
+    p.add_argument("--adversary_scale", type=float, default=None,
+                   help="attack magnitude (sign_flip/scale_boost "
+                        "multiplier, constant fill, collude delta norm)")
+    p.add_argument("--adversary_noise", type=float, default=None,
+                   help="gauss-mode perturbation stddev")
+    # -- cross-round reputation / quarantine (server rank) -----------------
+    p.add_argument("--quarantine_threshold", type=float, default=0.0,
+                   help="EWMA anomaly score above which a client is "
+                        "quarantined — excluded from aggregation but "
+                        "still served, so a false positive can earn "
+                        "its way back (0 = off; server rank, fedavg "
+                        "family; survives server restarts via "
+                        "--checkpoint_every)")
+    p.add_argument("--quarantine_decay", type=float, default=0.7,
+                   help="EWMA memory for the reputation score "
+                        "(higher = slower to trip and to forgive)")
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--repetitions", type=int, default=1)
     p.add_argument("--run_name", type=str, default=None)
@@ -210,15 +263,42 @@ def parse_args(argv=None) -> tuple[ExperimentConfig, argparse.Namespace]:
             num_rounds=a.comm_round,
             clients_per_round=a.client_num_per_round,
             eval_every=a.frequency_of_the_test,
-            robust_method=a.robust_method,
+            robust_method=a.defense or a.robust_method,
             robust_norm_clip=a.robust_norm_clip,
             robust_noise_stddev=a.robust_noise_stddev,
+            robust_num_adversaries=a.defense_num_adversaries,
+            robust_multikrum_m=a.defense_multikrum_m,
+            robust_trim_frac=a.defense_trim_frac,
+        ),
+        adversary=rep(
+            cfg.adversary,
+            mode=a.adversary_mode,
+            seed=a.adversary_seed,
+            ranks=tuple(a.adversary_ranks) if a.adversary_ranks else None,
+            num_adversaries=a.adversary_num,
+            scale=a.adversary_scale,
+            noise_stddev=a.adversary_noise,
         ),
         seed=a.seed,
         run_name=a.run_name,
         out_dir=a.out_dir,
         checkpoint_every=a.checkpoint_every,
     )
+    # surface defense/quarantine/adversary config errors at argument
+    # time (unconditionally — e.g. a bad --quarantine_decay with the
+    # threshold off would otherwise crash the server actor at
+    # construction): under --supervise a construction-time ValueError
+    # would crash-loop the server through its whole restart budget
+    from fedml_tpu.core.reputation import QuarantinePolicy
+    from fedml_tpu.core.robust import DefensePipeline, check_fednova_compat
+
+    try:
+        DefensePipeline.from_fed(cfg.fed)
+        QuarantinePolicy(threshold=a.quarantine_threshold,
+                         decay=a.quarantine_decay)
+        check_fednova_compat(cfg.fed.algorithm, cfg.fed.robust_method)
+    except ValueError as err:
+        raise SystemExit(str(err))
     return cfg, a
 
 
@@ -313,6 +393,8 @@ def _deploy_config(a) -> "DeployConfig":
         checkpoint_every=a.checkpoint_every or 0,
         recovery_extensions=a.recovery_extensions,
         fault=_fault_policy(a),
+        quarantine_threshold=a.quarantine_threshold,
+        quarantine_decay=a.quarantine_decay,
     )
 
 
@@ -420,6 +502,30 @@ def main(argv=None) -> int:
         # same wiring as the CLI)
         print(json.dumps(run_role(cfg, _deploy_config(a)), default=float))
         return 0
+    if a.quarantine_threshold:
+        # the reputation plane lives in the server ACTOR; the compiled
+        # simulator applies per-round defenses (--defense) but has no
+        # per-client identity to quarantine across rounds
+        print(
+            "warning: --quarantine_threshold is a deployment flag and "
+            "is ignored by the simulator (use --role/--supervise; "
+            "--defense still applies here)",
+            file=sys.stderr,
+        )
+    # adversary injection is wired into the FedAvgSim round program;
+    # other sims (mpc/secure-agg, GAN family, splitnn, ...) aggregate
+    # elsewhere and would silently run a vacuous Byzantine experiment
+    _ADVERSARY_SIMS = {"fedavg", "fedopt", "fedprox", "fednova",
+                       "fedavg_robust", "fedavg_multiclient", "fedseg"}
+    if (cfg.adversary.enabled()
+            and cfg.fed.algorithm not in _ADVERSARY_SIMS):
+        print(
+            f"warning: --adversary_* flags are ignored by the "
+            f"{cfg.fed.algorithm!r} simulator (adversary injection "
+            "covers the FedAvg-family round program: "
+            f"{sorted(_ADVERSARY_SIMS)})",
+            file=sys.stderr,
+        )
     if a.telemetry_dir or a.trace or a.trace_jax:
         from fedml_tpu.core import telemetry
 

@@ -66,6 +66,17 @@ class ShardedFedAvg(FedAvgSim):
         cfg: ExperimentConfig,
         mesh: Mesh,
     ):
+        if cfg.adversary.enabled():
+            # the sharded round program calls server_update directly —
+            # neither the adversary injection gate nor the non-finite
+            # screen of FedAvgSim._round runs here, so an "adversarial"
+            # sharded experiment would silently measure a clean run
+            raise ValueError(
+                "adversary injection is not wired into the "
+                "mesh-sharded round (it covers the single-process "
+                "FedAvgSim and the deploy-path client actor); run the "
+                "Byzantine scenario there, or disable cfg.adversary"
+            )
         self.mesh = mesh
         self.client_axis = cfg.mesh.client_axis_name
         self.data_axis = cfg.mesh.data_axis_name

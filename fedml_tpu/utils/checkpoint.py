@@ -57,7 +57,12 @@ class RoundCheckpointer:
         auto-numbered ``Dense_N`` heads) where current trees say
         ``Conv2D_N``/``ConvTranspose2D_N``/named heads; such checkpoints
         are migrated on restore by :func:`_migrate_scopes` instead of
-        failing the structure match."""
+        failing the structure match. A checkpoint written by the deploy
+        server (a ``{"server", "reputation"}`` composite — the actor
+        persists its Byzantine-reputation plane alongside the round
+        state) restored against a bare sim-state template is unwrapped
+        to its ``"server"`` payload, so a deploy run and a sim run of
+        one config keep sharing the resume story in BOTH directions."""
         step = self._mgr.latest_step()
         if step is None:
             return init_state, 0
@@ -78,14 +83,26 @@ class RoundCheckpointer:
             # cross-assigned weights.
             try:
                 raw = self._mgr.restore(step)
+                if (
+                    isinstance(raw, dict)
+                    and set(raw) == {"server", "reputation"}
+                    and not (isinstance(template, dict)
+                             and set(template) == {"server",
+                                                   "reputation"})
+                ):
+                    # deploy-server composite restored by a sim-shaped
+                    # caller: the round state is the "server" payload
+                    raw = raw["server"]
                 restored = _migrate_scopes(template, raw)
             except Exception:
                 raise err
             import warnings
 
             warnings.warn(
-                f"checkpoint at step {step} used legacy scope names; "
-                "restored via scope migration",
+                f"checkpoint at step {step} did not match the template "
+                "directly (legacy scope names, or a deploy-server "
+                "composite read by a sim); restored via structure "
+                "migration",
                 stacklevel=2,
             )
         return _from_savable(init_state, restored), step + 1
