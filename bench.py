@@ -1262,6 +1262,78 @@ def defense_overhead_records(cohorts=(10, 50), iters=10):
     return records
 
 
+def elastic_churn_record(rounds=24, num_clients=32, cohort=16, seed=0):
+    """Compile-cache hit rate under a seeded membership-churn schedule
+    (docs/FAULT_TOLERANCE.md "Elastic membership"): an elastic
+    simulator walks its cohort size across [cohort/4, cohort] every
+    round. The live count rides the compiled round as a traced
+    operand, so EVERY size inside the compiled bucket reuses one
+    program — expected: a single compile for the whole schedule.
+    ``value`` is the hit rate; the recompile count a static
+    (shape-per-cohort) runtime would have paid — one per distinct
+    size — rides alongside as the ratio the bucketing buys."""
+    import random as _random
+
+    import jax
+    import numpy as np
+
+    from fedml_tpu.config import (
+        DataConfig,
+        ExperimentConfig,
+        FedConfig,
+        ModelConfig,
+        TrainConfig,
+    )
+    from fedml_tpu.algorithms.fedavg import FedAvgSim
+    from fedml_tpu.core import telemetry
+    from fedml_tpu.data.loaders import load_dataset
+    from fedml_tpu.models import create_model
+
+    cfg = ExperimentConfig(
+        data=DataConfig(dataset="fake_mnist", num_clients=num_clients,
+                        batch_size=32, seed=0),
+        model=ModelConfig(name="lr", num_classes=10,
+                          input_shape=(28, 28, 1)),
+        train=TrainConfig(lr=0.1, epochs=1),
+        fed=FedConfig(num_rounds=rounds, clients_per_round=cohort,
+                      eval_every=10**9, elastic_buckets=True),
+        seed=0,
+    )
+    sim = FedAvgSim(create_model(cfg.model), load_dataset(cfg.data),
+                    cfg)
+    rng = _random.Random(seed)
+    schedule = [rng.randint(max(1, cohort // 4), cohort)
+                for _ in range(rounds)]
+    was_enabled = telemetry.METRICS.enabled
+    telemetry.METRICS.enabled = True
+    telemetry.METRICS.reset()
+    try:
+        state = sim.init()
+        t0 = time.perf_counter()
+        for n in schedule:
+            sim.set_cohort_size(n)
+            state, m = sim.run_round(state)
+        jax.block_until_ready(state.variables)
+        wall = time.perf_counter() - t0
+        c = telemetry.METRICS.snapshot()["counters"]
+    finally:
+        telemetry.METRICS.enabled = was_enabled
+        telemetry.METRICS.reset()
+    misses = int(c.get("elastic.compile_cache_misses", 0))
+    hits = int(c.get("elastic.compile_cache_hits", 0))
+    assert np.isfinite(float(m["train_loss"]))
+    return {
+        "metric": f"elastic_compile_cache_hit_rate_c{cohort}",
+        "value": round(hits / max(1, hits + misses), 4),
+        "unit": "hit_rate",
+        "rounds": rounds,
+        "cohort_schedule": schedule,
+        "compiles": misses,
+        "static_runtime_compiles": len(set(schedule)),
+        "wall_s": round(wall, 3),
+    }
+
+
 def main():
     ap = argparse.ArgumentParser(
         description="Plain `python bench.py` (what the driver runs) "
@@ -1304,6 +1376,11 @@ def main():
                     help="ONLY the Byzantine-defense aggregation "
                          "overhead stage (krum/multikrum/fltrust/"
                          "median/trimmed_mean vs plain mean)")
+    ap.add_argument("--elastic-bench", action="store_true",
+                    help="ONLY the elastic compile-cache stage: hit "
+                         "rate under a seeded membership-churn "
+                         "schedule (one compile per bucket vs one per "
+                         "distinct cohort size)")
     args = ap.parse_args()
 
     # Fail FAST if the device backend cannot come up: a wedged TPU
@@ -1398,6 +1475,9 @@ def main():
     if args.defense_bench:
         for rec in staged("defense", defense_overhead_records):
             emit(rec)
+        return
+    if args.elastic_bench:
+        emit(staged("elastic", elastic_churn_record))
         return
     if args.synthetic_acc:
         rec = staged("synthetic_acc", synthetic_leaf_acc_record)

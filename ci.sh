@@ -103,6 +103,13 @@ echo "== recovery smoke: SIGKILL server -> relaunch -> resume =="
 # "Recovery")
 JAX_PLATFORMS=cpu python scripts/kill_resume_smoke.py "$OUT/kill_resume"
 
+echo "== elastic smoke: mid-run admission + graceful LEAVE =="
+# a 1-server + 2-client gRPC world under --elastic admits a 3rd client
+# mid-run, survives a graceful LEAVE, completes every round, and
+# compiles the round function at most once per distinct cohort bucket
+# (docs/FAULT_TOLERANCE.md "Elastic membership")
+JAX_PLATFORMS=cpu python scripts/elastic_smoke.py "$OUT/elastic"
+
 echo "== 2/3 smoke matrix (tiny runs) =="
 # one process for the whole matrix: same CLI argv surface via
 # run.main(argv), but jax/backend startup and compile caches paid once

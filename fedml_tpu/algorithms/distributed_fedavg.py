@@ -25,8 +25,10 @@ import numpy as np
 
 from fedml_tpu.config import ExperimentConfig
 from fedml_tpu.core import adversary as A
+from fedml_tpu.core import elastic as E
 from fedml_tpu.core import robust, telemetry
 from fedml_tpu.core import tree as T
+from fedml_tpu.core.membership import MembershipLedger
 from fedml_tpu.core.reputation import QuarantinePolicy, ReputationTracker
 from fedml_tpu.core.manager import ClientManager, ServerManager
 from fedml_tpu.core.message import (
@@ -35,7 +37,9 @@ from fedml_tpu.core.message import (
     KEY_NUM_SAMPLES,
     KEY_ROUND,
     MSG_TYPE_C2S_JOIN,
+    MSG_TYPE_C2S_LEAVE,
     MSG_TYPE_C2S_RESULT,
+    MSG_TYPE_FINISH,
     MSG_TYPE_S2C_SYNC_MODEL,
     MSG_TYPE_S2C_WELCOME,
     Message,
@@ -214,6 +218,15 @@ class FedAvgServerActor(ServerManager):
         self.round_policy = (
             round_policy if round_policy is not None else RoundPolicy()
         )
+        # -- elastic membership (docs/FAULT_TOLERANCE.md "Elastic
+        # membership"): the ledger — not the launch world_size — is the
+        # source of truth for who is served. JOINs from ranks beyond
+        # the launch world are admitted mid-run with a stable client
+        # id; MSG_TYPE_C2S_LEAVE marks a graceful departure (no restart
+        # budget, no suspicion); eviction is permanent. The ledger
+        # rides the round checkpoint so a SIGKILLed server restores the
+        # grown/shrunk world, not the launch flag's.
+        self._ledger = MembershipLedger(size, num_clients)
         self.dead_peers: set[int] = set()
         self.failure: str | None = None  # quorum-lost diagnostic
         self._deadline_timer: threading.Timer | None = None
@@ -264,38 +277,56 @@ class FedAvgServerActor(ServerManager):
         self._quarantine = quarantine or QuarantinePolicy()
         self._reputation = ReputationTracker(size, self._quarantine)
         self._diag_fn = None  # lazily-jitted anomaly scorer
+        # -- shape-bucketed compiled rounds (core/elastic.py): with
+        # cfg.fed.elastic_buckets the aggregation pass is compiled once
+        # per power-of-two bucket (cohort padded with zero-weight /
+        # zero-delta rows every defense rule masks out) and held in an
+        # LRU of executables — membership churn costs a cache hit, not
+        # an XLA recompile. Off by default: the eager aggregation path
+        # below stays byte-identical to its pre-elastic self.
+        self._elastic = bool(cfg.fed.elastic_buckets)
+        self._agg_cache = (
+            E.CompiledRoundCache(self._bucketed_update)
+            if self._elastic else None
+        )
+        self._diag_cache = (
+            E.CompiledRoundCache(self._bucketed_diag)
+            if self._elastic else None
+        )
         if checkpointer is not None:
             if checkpoint_every < 1:
                 raise ValueError(
                     f"checkpoint_every must be >= 1 with a checkpointer, "
                     f"got {checkpoint_every}"
                 )
-            template = {
-                "server": self.state,
-                "reputation": self._reputation.state_arrays(),
-            }
-            try:
-                restored, start = checkpointer.restore_or(template)
-            except (ValueError, KeyError, TypeError):
-                # checkpoint written before the reputation plane: the
-                # payload is a bare ServerState. Restore it under the
-                # legacy template and start with a clean reputation —
-                # an upgraded server must resume, not crash-loop the
-                # Supervisor's restart budget away.
-                state, start = checkpointer.restore_or(self.state)
-                restored = {
-                    "server": state,
-                    "reputation": self._reputation.state_arrays(),
-                }
-                import warnings
+            from fedml_tpu.utils.checkpoint import from_savable
 
-                warnings.warn(
-                    "restored a pre-reputation checkpoint (bare "
-                    "ServerState); quarantine state starts fresh",
-                    stacklevel=2,
-                )
-            self.state = restored["server"]
-            self._reputation.load_arrays(restored["reputation"])
+            raw, start = checkpointer.restore_raw()
+            if raw is not None:
+                if isinstance(raw, dict) and "server" in raw:
+                    # composite payload (PR 4+): server state + the
+                    # reputation plane, + the membership ledger once
+                    # the world went elastic. Reputation/membership
+                    # arrays adapt to a DIFFERENT relaunch world size
+                    # — the checkpoint is authoritative.
+                    self.state = from_savable(self.state, raw["server"])
+                    self._reputation.load_arrays(raw["reputation"])
+                    if "membership" in raw:
+                        self._ledger.load_arrays(raw["membership"])
+                else:
+                    # checkpoint written before the reputation plane:
+                    # a bare ServerState. Restore it and start with a
+                    # clean reputation — an upgraded server must
+                    # resume, not crash-loop the Supervisor's restart
+                    # budget away.
+                    self.state = from_savable(self.state, raw)
+                    import warnings
+
+                    warnings.warn(
+                        "restored a pre-reputation checkpoint (bare "
+                        "ServerState); quarantine state starts fresh",
+                        stacklevel=2,
+                    )
             if start:
                 if int(self.state.round) != start:
                     raise ValueError(
@@ -312,10 +343,15 @@ class FedAvgServerActor(ServerManager):
         self.register_message_receive_handler(
             MSG_TYPE_C2S_RESULT, self._handle_result
         )
-        # library-path rejoin entry; the deployment barrier re-registers
-        # this type with its pre-kickoff-aware wrapper (deploy.py)
+        # library-path membership entries; the deployment barrier
+        # re-registers JOIN with its pre-kickoff-aware wrapper
+        # (deploy.py)
         self.register_message_receive_handler(
-            MSG_TYPE_C2S_JOIN, lambda msg: self.on_peer_rejoin(msg.sender)
+            MSG_TYPE_C2S_JOIN, lambda msg: self.on_peer_join(msg.sender)
+        )
+        self.register_message_receive_handler(
+            MSG_TYPE_C2S_LEAVE,
+            lambda msg: self.on_peer_leave(msg.sender),
         )
 
     @property
@@ -327,8 +363,11 @@ class FedAvgServerActor(ServerManager):
         ``FedAVGAggregator.py:90-98``). In the distributed path the cohort
         size is the worker count, as in the reference (one MPI rank per
         sampled client, ``FedAvgAPI.py:36-66``); if there are more workers
-        than clients the assignment wraps so every worker gets a client."""
-        n_workers = self.size - 1
+        than clients the assignment wraps so every worker gets a client.
+        The worker count is the CURRENT membership (elastic worlds grow
+        and shrink it); in a static world it equals the launch
+        ``size - 1`` and the draw is unchanged."""
+        n_workers = max(1, len(self._member_workers()))
         if n_workers >= self.num_clients:
             return np.arange(self.num_clients)
         rng = np.random.default_rng(self.round_idx)
@@ -336,9 +375,23 @@ class FedAvgServerActor(ServerManager):
 
     # -- straggler accounting (all under self._lock) -----------------------
 
+    def client_ranks(self) -> list[int]:
+        """Every currently-ACTIVE member (broadcast / FINISH targets) —
+        including admissions whose first round is still ahead, and
+        excluding departed ranks."""
+        return self._ledger.active_ranks()
+
+    def _member_workers(self) -> list[int]:
+        """Members participating in the CURRENT round: ACTIVE, and
+        admitted at or before this round's boundary (a mid-round
+        admission must not raise the in-flight round's quorum bar for a
+        sync it never received)."""
+        return self._ledger.active_ranks(self.round_idx)
+
     def _live_workers(self) -> list[int]:
         return [
-            r for r in range(1, self.size) if r not in self.dead_peers
+            r for r in self._member_workers()
+            if r not in self.dead_peers
         ]
 
     def _quorum(self) -> int:
@@ -400,19 +453,27 @@ class FedAvgServerActor(ServerManager):
             telemetry.set_current_trace(telemetry.new_trace_id())
             tr.log_round_start(self.round_idx)
         host_vars = jax.tree.map(np.asarray, self.variables)
+        # slot = the rank's position among this round's MEMBER workers:
+        # in a static launch world that is exactly rank-1 (the historic
+        # assignment); in an elastic world it stays dense as ranks
+        # beyond the launch world join and others leave
+        slots = {r: i for i, r in enumerate(self._member_workers())}
         with self._lock:
             ranks = self._live_workers()
             self._extensions_used = 0
             self._deadline_gen += 1
             gen = self._deadline_gen
-            # one consistent (round, model, cohort) snapshot: WELCOME
-            # replies to mid-round rejoiners replay exactly this sync
-            self._round_sync = (self.round_idx, host_vars, cohort)
+            # one consistent (round, model, cohort, slots) snapshot:
+            # WELCOME replies to mid-round rejoiners replay exactly
+            # this sync
+            self._round_sync = (self.round_idx, host_vars, cohort, slots)
         self.broadcast(
             MSG_TYPE_S2C_SYNC_MODEL,
             lambda r: {
                 KEY_MODEL_PARAMS: host_vars,
-                KEY_CLIENT_INDEX: int(cohort[(r - 1) % len(cohort)]),
+                KEY_CLIENT_INDEX: int(
+                    cohort[slots.get(r, r - 1) % len(cohort)]
+                ),
                 KEY_ROUND: self.round_idx,
             },
             ranks=ranks,
@@ -432,6 +493,108 @@ class FedAvgServerActor(ServerManager):
         """A model sync that cannot be shipped == a crashed worker; the
         round proceeds without it rather than aborting the broadcast."""
         self.on_peer_dead(rank)
+
+    def on_peer_join(self, rank: int) -> str | None:
+        """Unified JOIN entry (docs/FAULT_TOLERANCE.md "Elastic
+        membership"): dispatches on the membership ledger's verdict —
+
+        - an ACTIVE member's JOIN is the crash-recovery REJOIN
+          (:meth:`on_peer_rejoin`, unchanged) — unless its admission
+          has not taken effect yet (a just-admitted rank's announce
+          loop re-sends JOIN until the next round's sync arrives; a
+          WELCOME now would pull it into the CURRENT round, whose
+          quorum and cohort slots were fixed without it);
+        - an unknown or previously-LEFT rank is ADMITTED: stable client
+          id assigned, liveness armed, first cohort slot at the next
+          round boundary — or THIS round's, when no round is in flight
+          yet (a restored all-departed world admitting its next member
+          pre-kickoff must serve it in the round it is about to
+          broadcast, not one past it);
+        - an EVICTED rank is rejected silently — never ACKed, so the
+          banned client's announce loop times out loudly on its side
+          instead of idling against a world that will not serve it.
+        """
+        with self._lock:
+            if self.done.is_set() or self.failure is not None:
+                return None
+            if not self._elastic and self._ledger.status(rank) is None:
+                # static world, never-seen rank: drop un-ACKed — the
+                # pre-elastic contract (run.py's client-side guard
+                # says "a static server drops it", and admitting here
+                # would shift every member's cohort slot in a world
+                # the operator configured as fixed)
+                telemetry.METRICS.inc("membership.rejected_joins")
+                return None
+            sync = self._round_sync
+            in_flight = sync is not None and sync[0] == self.round_idx
+            verdict = self._ledger.admit(rank, self.round_idx,
+                                         immediate=not in_flight)
+            effective = rank in self._ledger.active_ranks(self.round_idx)
+        if verdict == "rejected":
+            return verdict
+        if verdict == "member":
+            if effective:
+                self.on_peer_rejoin(rank)
+            return verdict
+        # newly admitted (or returning after a graceful LEAVE): a crash
+        # while LEFT is impossible, so there is no dead-peer state to
+        # reverse — just arm liveness and grow the per-rank planes
+        self._reputation.ensure_size(rank + 1)
+        with self._lock:
+            self.dead_peers.discard(rank)
+        if self.liveness is not None:
+            self.liveness.watch(rank)
+        return verdict
+
+    def on_peer_leave(self, rank: int) -> None:
+        """Graceful departure (``MSG_TYPE_C2S_LEAVE``): the rank is
+        marked LEFT in the ledger — NOT dead. No restart budget is
+        spent, no dead-peer flight dump fires, and its reputation is
+        frozen, not laundered (a later rejoin resumes the same score).
+        A result it already submitted this round stays valid (it
+        contributed, then left). The round re-evaluates its close
+        condition immediately: the departed rank no longer counts
+        toward quorum."""
+        left = self._ledger.leave(rank, self.round_idx)
+        if not left:
+            return
+        if self.liveness is not None:
+            self.liveness.unwatch(rank)
+        with self._lock:
+            self.dead_peers.discard(rank)
+            self._welcomed.pop(rank, None)
+        self._maybe_close_round(deadline_fired=False)
+
+    def evict_rank(self, rank: int, notify: bool = True) -> None:
+        """Permanent eviction: future JOINs from this rank are rejected
+        (the one transition nothing undoes short of a fresh run dir).
+        Used by operators via the library API and by the quarantine
+        plane's ``evict_after`` policy. ``notify=False`` skips the
+        FINISH to the banned rank — the restart replay path uses it,
+        where the rank's process already exited and a send would only
+        sit out the transport's full retry budget."""
+        self._ledger.evict(rank, self.round_idx)
+        if self.liveness is not None:
+            self.liveness.unwatch(rank)
+        with self._lock:
+            self.dead_peers.discard(rank)
+            self._results.pop(rank, None)
+            self._welcomed.pop(rank, None)
+        # tell the banned rank to wind down cleanly: under a supervisor
+        # an evicted client left idling would otherwise crash-loop its
+        # restart budget (its JOINs are never ACKed) and take the whole
+        # world down with it — a FINISH carrying the reason lets it
+        # exit 0 with status "evicted", which the Supervisor treats
+        # like a graceful LEAVE (gone by design, never respawned)
+        if notify:
+            try:
+                self.send_message(Message(
+                    MSG_TYPE_FINISH, self.rank, rank,
+                    {"reason": "evicted"},
+                ))
+            except Exception:
+                pass  # peer unreachable; announce loop times out loudly
+        self._maybe_close_round(deadline_fired=False)
 
     def on_peer_rejoin(self, rank: int) -> None:
         """Rejoin entry (``MSG_TYPE_C2S_JOIN`` mid-run, docs/
@@ -476,7 +639,7 @@ class FedAvgServerActor(ServerManager):
         telemetry.RECORDER.record("rejoin", peer=rank, was_dead=was_dead)
         if sync is None:
             return  # no round underway; the next broadcast covers it
-        round_idx, host_vars, cohort = sync
+        round_idx, host_vars, cohort, slots = sync
         try:
             self.send_message(
                 Message(
@@ -486,7 +649,8 @@ class FedAvgServerActor(ServerManager):
                     {
                         KEY_MODEL_PARAMS: host_vars,
                         KEY_CLIENT_INDEX: int(
-                            cohort[(rank - 1) % len(cohort)]
+                            cohort[slots.get(rank, rank - 1)
+                                   % len(cohort)]
                         ),
                         KEY_ROUND: round_idx,
                     },
@@ -556,11 +720,16 @@ class FedAvgServerActor(ServerManager):
                 return
             live = self._live_workers()
             n_results = len(self._results)
+            # the fast-path close means "every LIVE worker reported":
+            # a graceful leaver's booked result stays valid for quorum
+            # and aggregation, but must not stand in for a still-
+            # computing live member's
+            n_live_results = sum(1 for r in live if r in self._results)
             quorum = self._quorum()
             abort = results = None
             closed_idx = self.round_idx
             dead = sorted(self.dead_peers)  # snapshot under the lock
-            if live and (n_results >= len(live) or (
+            if live and (n_live_results >= len(live) or (
                 deadline_fired and n_results >= quorum
             )):
                 results, self._results = self._results, {}
@@ -569,6 +738,15 @@ class FedAvgServerActor(ServerManager):
                     self._deadline_timer.cancel()
                     self._deadline_timer = None
             elif deadline_fired or not live:
+                sync = self._round_sync
+                if not deadline_fired and (
+                        sync is None or sync[0] != self.round_idx):
+                    # no-live-workers check with NO round in flight: a
+                    # restored server replaying presumed departures
+                    # before kickoff (every member departed by design).
+                    # There is nothing to abort — the ready barrier is
+                    # waiting for the next admission to BE the world
+                    return
                 # under quorum (or out of workers entirely): abort only
                 # after recovery is exhausted — each extension re-arms
                 # the deadline so a supervised restart can rejoin and
@@ -605,9 +783,15 @@ class FedAvgServerActor(ServerManager):
                         if self.round_policy.recovery_extensions
                         else ""
                     )
+                    # the MEMBER count, not the launch world: an
+                    # elastic run may have grown/shrunk — and "no live
+                    # workers" covers graceful departures too, not just
+                    # deaths
                     abort = (
-                        f"all {self.size - 1} workers died before "
-                        f"round {self.round_idx} closed{spent}"
+                        f"no live workers left before round "
+                        f"{self.round_idx} closed "
+                        f"({len(self._member_workers())} members, "
+                        f"dead peers {sorted(self.dead_peers)}{spent})"
                     )
                 else:
                     abort = (
@@ -660,6 +844,12 @@ class FedAvgServerActor(ServerManager):
             return True
         if msg.sender in self.dead_peers:
             return True  # declared dead; its late result is void
+        if self._ledger.status(msg.sender) == "evicted":
+            # evict_rank voided this rank's pending result; a copy
+            # still in flight must not be re-accepted into the round
+            # (a LEFT rank's result stays valid — it contributed,
+            # then departed — but a BAN is authoritative)
+            return True
         if msg.sender in self._results:
             telemetry.METRICS.inc("round.duplicate_results")
             return True
@@ -698,22 +888,64 @@ class FedAvgServerActor(ServerManager):
     def quarantined_ranks(self) -> list[int]:
         return self._reputation.quarantined()
 
-    def _diagnose(self, stacked_vars) -> dict[str, np.ndarray]:
-        """Per-client anomaly scores over this round's results (one
-        jitted flatten + gram matmul, core/robust.anomaly_scores);
-        recompiles per distinct result count, which a quorum-shrunk
-        round changes rarely."""
-        if self._diag_fn is None:
-            def fn(stacked_params, gp):
-                deltas = jax.tree.map(
-                    lambda s, g: s - g[None], stacked_params, gp
-                )
-                return robust.anomaly_scores(deltas)
+    @property
+    def membership(self) -> dict:
+        """Rank lists per membership status (run-summary view)."""
+        return self._ledger.summary()
 
-            self._diag_fn = jax.jit(fn)
-        out = self._diag_fn(
-            stacked_vars["params"], self.state.variables["params"]
+    def _bucketed_update(self, state, stacked_vars, n_k, valid, rkey):
+        """The bucket-compiled aggregation body: exactly the eager
+        path's ``server_update`` with the padding mask threaded through
+        (zero-weight, zero-delta pad rows cannot perturb any rule —
+        core/elastic.py)."""
+        return server_update(
+            self.cfg.fed,
+            self.cfg.train,
+            self.steps_per_epoch,
+            self.batch_size,
+            state,
+            stacked_vars,
+            n_k,
+            rkey,
+            local_reducer(),
+            valid=valid,
         )
+
+    @staticmethod
+    def _bucketed_diag(stacked_params, gp, valid):
+        deltas = jax.tree.map(
+            lambda s, g: s - g[None], stacked_params, gp
+        )
+        return robust.anomaly_scores(deltas, valid)
+
+    def _diagnose(self, stacked_vars,
+                  n_rows: int | None = None) -> dict[str, np.ndarray]:
+        """Per-client anomaly scores over this round's results (one
+        jitted flatten + gram matmul, core/robust.anomaly_scores).
+        Static path: recompiles per distinct result count, which a
+        quorum-shrunk round changes rarely. Elastic path
+        (``n_rows``): the stack is padded to its bucket and scored by
+        a bucket-compiled executable, so membership churn never
+        retraces the scorer; rows past ``n_rows`` are padding debris
+        and are sliced off before anything host-side sees them."""
+        gp = self.state.variables["params"]
+        if self._elastic and n_rows is not None:
+            bucket = E.bucket_for(n_rows)
+            padded, _, valid = E.pad_stacked(
+                stacked_vars["params"],
+                np.ones((n_rows,), np.float32),
+                gp,
+                bucket,
+            )
+            out = self._diag_cache(bucket, padded, gp, valid)
+            return {k: np.asarray(v)[:n_rows] for k, v in out.items()}
+        if self._diag_fn is None:
+            # same pipeline as the bucketed scorer, no padding mask
+            # (anomaly_scores treats valid=None as all-valid)
+            self._diag_fn = jax.jit(
+                lambda s, gp: self._bucketed_diag(s, gp, None)
+            )
+        out = self._diag_fn(stacked_vars["params"], gp)
         return {k: np.asarray(v) for k, v in out.items()}
 
     def _score_and_exclude(
@@ -736,10 +968,24 @@ class FedAvgServerActor(ServerManager):
         )
         if not score_now or not ranks:
             return ranks, None
+        self._reputation.ensure_size(max(ranks) + 1)
         stacked_all = T.tree_stack([results[r][0] for r in ranks])
-        diag = self._diagnose(stacked_all)
+        diag = self._diagnose(stacked_all, len(ranks))
         events = self._reputation.observe(closed_idx, ranks,
                                           diag["score"])
+        if self._quarantine.evict_after > 0:
+            # quarantine -> eviction escalation: a rank that has sat in
+            # quarantine for evict_after FULL rounds without earning
+            # release is permanently banned (docs/FAULT_TOLERANCE.md
+            # "Elastic membership"). Strictly more than: the round that
+            # TRIPPED the quarantine (closed_idx == q_at) is not a
+            # round "sat without release" — evict_after=1 promises one
+            # recoverable round, not an instant ban
+            for r in list(self._reputation.quarantined()):
+                q_at = int(self._reputation.quarantined_at[r])
+                if (closed_idx - q_at >= self._quarantine.evict_after
+                        and self._ledger.status(r) != "evicted"):
+                    self.evict_rank(r)
         excluded = [r for r in ranks
                     if self._reputation.is_quarantined(r)]
         included = [r for r in ranks if r not in excluded]
@@ -818,9 +1064,12 @@ class FedAvgServerActor(ServerManager):
             if n_live is not None and n_live > len(results):
                 # live workers whose results the deadline cut out
                 m.inc("round.stragglers", n_live - len(results))
-            if len(results) < self.size - 1:
-                # fewer results than the full cohort: the weighted mean
-                # below renormalizes over the survivors' sample mass
+            if len(results) < len(self._ledger.active_ranks(closed_idx)):
+                # fewer results than the CLOSED round's members (the
+                # elastic world's count, not the launch world_size —
+                # round_idx has already advanced here): the weighted
+                # mean below renormalizes over the survivors' sample
+                # mass
                 m.inc("round.quorum_renormalizations")
         telemetry.RECORDER.record(
             "round_close", round=closed_idx, results=len(results),
@@ -831,17 +1080,32 @@ class FedAvgServerActor(ServerManager):
             stacked = T.tree_stack([results[r][0] for r in included])
         weights = jnp.asarray([results[r][1] for r in included])
         rkey = RND.round_key(self.root_key, self.state.round)
-        self.state = server_update(
-            self.cfg.fed,
-            self.cfg.train,
-            self.steps_per_epoch,
-            self.batch_size,
-            self.state,
-            jax.tree.map(jnp.asarray, stacked),
-            weights,
-            rkey,
-            local_reducer(),
-        )
+        if self._elastic:
+            # shape-bucketed aggregation (core/elastic.py): pad the
+            # cohort to its power-of-two bucket and run the
+            # bucket-compiled executable — a cohort-size change between
+            # rounds (membership churn, quorum-shrunk closes) is a
+            # compile-cache hit, not an XLA recompile
+            bucket = E.bucket_for(len(included))
+            padded, w, valid = E.pad_stacked(
+                jax.tree.map(jnp.asarray, stacked), weights,
+                self.variables, bucket,
+            )
+            self.state = self._agg_cache(
+                bucket, self.state, padded, w, valid, rkey
+            )
+        else:
+            self.state = server_update(
+                self.cfg.fed,
+                self.cfg.train,
+                self.steps_per_epoch,
+                self.batch_size,
+                self.state,
+                jax.tree.map(jnp.asarray, stacked),
+                weights,
+                rkey,
+                local_reducer(),
+            )
         if self._ckpt is not None and (
             (closed_idx + 1) % self.checkpoint_every == 0
             or closed_idx + 1 >= self.cfg.fed.num_rounds
@@ -849,11 +1113,14 @@ class FedAvgServerActor(ServerManager):
             # atomic orbax save of the FULL ServerState — variables,
             # server-optimizer state, momentum, and the round counter
             # every RNG fold derives from — plus the reputation plane
-            # (quarantine must survive a server SIGKILL), keyed by the
+            # (quarantine must survive a server SIGKILL) and the
+            # membership ledger (a restarted server must serve the
+            # grown/shrunk world, not the launch flag's), keyed by the
             # closed round, so a restart resumes here, not round 0
             self._ckpt.save(closed_idx, {
                 "server": self.state,
                 "reputation": self._reputation.state_arrays(),
+                "membership": self._ledger.state_arrays(),
             })
             telemetry.METRICS.inc("recovery.checkpoints")
             telemetry.RECORDER.record("checkpoint", round=closed_idx)
@@ -888,10 +1155,18 @@ class FedAvgClientActor(ClientManager):
         model: FedModel,
         data: FederatedData,
         cfg: ExperimentConfig,
+        leave_after_round: int | None = None,
     ):
         super().__init__(rank, size, transport)
         self.cfg = cfg
         self.model = model
+        # elastic membership (docs/FAULT_TOLERANCE.md "Elastic
+        # membership"): after submitting the result for this round the
+        # client announces a GRACEFUL departure and winds down — the
+        # server marks it LEFT (no dead-peer suspicion, no restart
+        # budget), and a supervisor sees a clean exit
+        self.leave_after_round = leave_after_round
+        self.left = threading.Event()
         self.arrays, batch = arrays_and_batch(data, cfg.data)
         max_n = self.arrays.max_client_samples
         task = make_task(data.task)
@@ -961,3 +1236,18 @@ class FedAvgClientActor(ClientManager):
                 },
             )
         )
+        if (self.leave_after_round is not None
+                and round_idx >= self.leave_after_round):
+            # contribute this round's result, THEN depart gracefully:
+            # LEAVE after RESULT on the same ordered channel, so the
+            # server books the contribution before the departure
+            try:
+                self.send_message(
+                    Message(MSG_TYPE_C2S_LEAVE, self.rank, 0, {})
+                )
+            except Exception:
+                pass  # server gone; heartbeat staleness covers it
+            self.left.set()
+            telemetry.RECORDER.record("leave", rank=self.rank,
+                                      round=round_idx)
+            self.finish()

@@ -31,6 +31,7 @@ import optax
 
 from fedml_tpu.config import ExperimentConfig, FedConfig, TrainConfig
 from fedml_tpu.core import adversary as A
+from fedml_tpu.core import elastic as E
 from fedml_tpu.core import random as R
 from fedml_tpu.core import robust, telemetry, tree as T
 from fedml_tpu.data.federated import FederatedArrays, FederatedData, arrays_and_batch
@@ -128,11 +129,20 @@ def server_update(
     n_k: jax.Array,
     rkey: jax.Array,
     red: Reducer,
+    valid: jax.Array | None = None,
 ) -> ServerState:
     """One server step from stacked client results. Shared between the
     single-device and mesh-sharded rounds (reference equivalents:
     ``FedAVGAggregator.aggregate``, ``FedOptAggregator``,
-    ``fednova.py`` tau-normalized averaging, ``RobustAggregator``)."""
+    ``fednova.py`` tau-normalized averaging, ``RobustAggregator``).
+
+    ``valid`` (``[C]`` bool, possibly traced) marks the live rows of a
+    bucket-padded elastic cohort (:mod:`fedml_tpu.core.elastic`):
+    padded rows carry the global variables (delta exactly zero) and
+    weight 0, and every defense rule masks them out — the aggregate
+    depends only on the live rows (content-blind, pinned bitwise in
+    ``tests/test_elastic.py``) while the compiled program's shapes —
+    and therefore the XLA cache — depend only on the bucket."""
     global_params = state.variables["params"]
     deltas = jax.tree.map(
         lambda s, g: s - g[None], stacked_vars["params"], global_params
@@ -149,7 +159,9 @@ def server_update(
     robust.check_fednova_compat(fed.algorithm, pipe.method)
     if fed.algorithm == "fednova":
         # tau_k = true local steps (real-first batch ordering makes this
-        # exact); d_k = delta_k / tau_k; delta = tau_eff * sum p_k d_k
+        # exact); d_k = delta_k / tau_k; delta = tau_eff * sum p_k d_k.
+        # Padded rows are weight-0 everywhere n_k appears, so they
+        # vanish from n_total, tau_eff, and the weighted mean exactly.
         tau = (
             jnp.ceil(n_k / batch_size).clip(1, steps_per_epoch)
             * train.epochs
@@ -161,7 +173,7 @@ def server_update(
         )
         agg_delta = T.tree_scale(red.wmean(d, n_k), tau_eff)
     else:
-        agg_delta = pipe.reduce(deltas, n_k, red)
+        agg_delta = pipe.reduce(deltas, n_k, red, valid)
 
     agg_delta = pipe.postprocess(agg_delta, jax.random.fold_in(rkey, 1))
 
@@ -263,6 +275,33 @@ class FedAvgSim:
         # ~3x on conv models — see fedml_tpu.models.cohort). Explicitly
         # disabled with TrainConfig(cohort_fused=False).
         cohort = min(cfg.fed.clients_per_round, cfg.data.num_clients)
+        # -- elastic shape bucketing (core/elastic.py, docs/
+        # FAULT_TOLERANCE.md "Elastic membership"): the round program is
+        # compiled for the power-of-two BUCKET above the cohort, with
+        # the live count a traced operand — set_cohort_size() then
+        # changes the cohort within the bucket without a recompile.
+        # Padded slots run masked local updates (weight 0, params
+        # healed to the global model) that provably cannot perturb any
+        # aggregation rule. Off by default: the static path stays
+        # byte-identical to its pre-elastic self.
+        self._elastic = bool(cfg.fed.elastic_buckets)
+        if self._elastic and sampler is not None:
+            # the bucketed round draws a full-bucket permutation whose
+            # live PREFIX is the cohort (_sample_bucket) — a
+            # (key, n, k) sampler cannot express that contract, and
+            # silently ignoring it would report uniform-sampling
+            # results under the user's sampler's name
+            raise ValueError(
+                "elastic_buckets=True is incompatible with a custom "
+                "cohort sampler: the compiled bucketed round draws its "
+                "own full-bucket permutation (core/elastic.py). "
+                "Disable elastic buckets or drop the sampler."
+            )
+        self._bucket = (
+            min(E.bucket_for(cohort), cfg.data.num_clients)
+            if self._elastic else cohort
+        )
+        self._n_active = cohort
         self._cohort_groups = _resolve_cohort_groups(
             cfg.train.cohort_groups, cohort
         )
@@ -273,6 +312,9 @@ class FedAvgSim:
             )
             if cfg.train.cohort_fused
             and cohort_update_supported(model, cfg.train)
+            # the cohort-grouped network bakes the cohort size into its
+            # widened layer shapes — bucketing covers the vmapped path
+            and not self._elastic
             else None
         )
         self.evaluator = build_evaluator(model, self.task)
@@ -302,8 +344,45 @@ class FedAvgSim:
             round=jnp.asarray(0, jnp.int32),
         )
 
+    # -- elastic cohort control (core/elastic.py) --------------------------
+    def set_cohort_size(self, n: int) -> None:
+        """Change the live cohort size for subsequent rounds WITHOUT a
+        recompile, as long as ``n`` fits the compiled bucket — the
+        simulator face of elastic membership (a churn schedule walks
+        this up and down; docs/FAULT_TOLERANCE.md "Elastic
+        membership")."""
+        if not self._elastic:
+            raise ValueError(
+                "set_cohort_size requires FedConfig(elastic_buckets="
+                "True) — the static round program bakes the cohort "
+                "size into its shapes"
+            )
+        if not (1 <= n <= self._bucket):
+            raise ValueError(
+                f"cohort size {n} does not fit the compiled bucket "
+                f"{self._bucket} (grow needs a new simulator; within "
+                f"[1, {self._bucket}] changes are free)"
+            )
+        self._n_active = n
+
+    def _sample_bucket(self, key, num_clients: int) -> jax.Array:
+        """Sample BUCKET client ids; the live prefix of the draw is the
+        round's cohort (the active mask hides the rest)."""
+        if self._bucket >= num_clients:
+            # a permutation, not arange: the active mask keeps the live
+            # PREFIX of this draw, so a fixed order would pin the same
+            # first-n_active clients into every round once the bucket
+            # covers the whole population
+            return jax.random.permutation(key, num_clients).astype(
+                jnp.int32
+            )
+        return jax.random.choice(
+            key, num_clients, shape=(self._bucket,), replace=False
+        ).astype(jnp.int32)
+
     # -- one round ---------------------------------------------------------
-    def _locals(self, state: ServerState, arrays: FederatedArrays):
+    def _locals(self, state: ServerState, arrays: FederatedArrays,
+                n_active=None):
         """Sampling + local updates, the pre-aggregation prefix of the
         round: returns (stacked_vars, n_k, metric sums, round key,
         cohort). Shared with aggregation rules that live outside the
@@ -314,11 +393,16 @@ class FedAvgSim:
         adversary injection gate) never re-derive the draw."""
         cfg = self.cfg.fed
         rkey = R.round_key(self.root_key, state.round)
-        cohort = self.sampler(
-            jax.random.fold_in(rkey, 0),
-            arrays.num_clients,
-            cfg.clients_per_round,
-        )
+        if n_active is not None:
+            cohort = self._sample_bucket(
+                jax.random.fold_in(rkey, 0), arrays.num_clients
+            )
+        else:
+            cohort = self.sampler(
+                jax.random.fold_in(rkey, 0),
+                arrays.num_clients,
+                cfg.clients_per_round,
+            )
         ckeys = jax.vmap(lambda c: R.client_key(rkey, c))(cohort)
         idx_rows = arrays.idx[cohort]
         mask_rows = arrays.mask[cohort]
@@ -384,15 +468,27 @@ class FedAvgSim:
         rejected = (ok.shape[0] - jnp.sum(ok)).astype(jnp.float32)
         return cleaned, n_k, rejected
 
-    def _round(self, state: ServerState, arrays: FederatedArrays):
+    def _round(self, state: ServerState, arrays: FederatedArrays,
+               n_active=None):
         cfg = self.cfg.fed
         stacked_vars, n_k, msums, rkey, cohort = self._locals(
-            state, arrays
+            state, arrays, n_active
         )
 
         if self.cfg.adversary.enabled():
             stacked_vars = self._inject_adversaries(
                 state, arrays, stacked_vars, cohort
+            )
+        live = None
+        if n_active is not None:
+            # elastic bucketing: the padded slots beyond the live
+            # cohort are healed to the global model (delta exactly 0)
+            # with zero weight BEFORE screening, so downstream they are
+            # indistinguishable from absent — and they must not pollute
+            # the round's train metrics either
+            live = E.active_mask(self._bucket, n_active)
+            stacked_vars, n_k, msums = E.mask_padded(
+                stacked_vars, n_k, msums, state.variables, live
             )
         stacked_vars, n_k, rejected = self._screen_nonfinite(
             state, stacked_vars, n_k
@@ -408,6 +504,7 @@ class FedAvgSim:
             n_k,
             rkey,
             local_reducer(),
+            valid=live,
         )
         reduced = jax.tree.map(jnp.sum, msums)
         fin = finalize_sums(reduced)
@@ -423,7 +520,18 @@ class FedAvgSim:
 
     # -- public API --------------------------------------------------------
     def run_round(self, state: ServerState):
-        return self._round_fn(state, self.arrays)
+        if not self._elastic:
+            return self._round_fn(state, self.arrays)
+        # the live count rides as a TRACED operand: any cohort size in
+        # [1, bucket] reuses the one compiled program; jit's own cache
+        # is the executable store here
+        return E.mirror_jit_cache(
+            self._round_fn,
+            lambda: self._round_fn(
+                state, self.arrays,
+                jnp.asarray(self._n_active, jnp.int32),
+            ),
+        )
 
     def evaluate_global(self, state: ServerState) -> dict:
         m = self.evaluator(

@@ -123,6 +123,14 @@ class FedConfig:
     robust_trim_frac: float = 0.1
     # FedNova normalized averaging
     gmf: float = 0.0  # global momentum factor
+    # elastic shape bucketing (core/elastic.py, docs/FAULT_TOLERANCE.md
+    # "Elastic membership"): pad the cohort to the next power-of-two
+    # bucket with masked zero-weight rows so cohort-size churn (mid-run
+    # admission/LEAVE on the deploy path, set_cohort_size on the sims)
+    # costs a compile-cache hit instead of an XLA recompile. Off by
+    # default: the static path stays byte-identical to its
+    # pre-elastic self.
+    elastic_buckets: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1,0 +1,219 @@
+"""Shape-bucketed compiled rounds: membership churn costs a cache hit.
+
+Every distinct cohort size hands XLA a new ``[C, ...]`` stacked-delta
+shape — and therefore a full recompile of the aggregation program. In a
+static world that happens once; in an elastic world (mid-run admission,
+graceful LEAVEs, crashes — docs/FAULT_TOLERANCE.md "Elastic
+membership") the cohort size walks up and down every few rounds and a
+naive runtime spends more time in XLA than in training.
+
+The fix is the classic bucketing trick: pad the cohort to the next
+power-of-two **bucket** with zero-weight rows whose delta is exactly
+zero (the padded row carries the global variables, so ``stacked - g``
+vanishes — the same healed-row construction PR 4's non-finite screen
+uses). Those rows provably cannot perturb any supported aggregation
+rule:
+
+- ``mean`` / FedNova: weight 0 ⇒ every padded term is an exact ``±0``
+  in both numerator and denominator sums;
+- ``median`` / ``trimmed_mean``: the mask-aware variants
+  (:func:`fedml_tpu.core.robust.coordinate_median` /
+  ``trimmed_mean`` with ``valid``) sort invalid rows to the far end and
+  reduce over the valid prefix only;
+- ``krum`` / ``multikrum``: invalid rows score :data:`robust._FAR` and
+  the neighbor count derives from the VALID count;
+- ``fltrust``: invalid rows get zero trust.
+
+The exact contract ``tests/test_elastic.py`` pins, in two tiers:
+
+1. **Content-blindness (bitwise, every rule)**: at a fixed bucket, the
+   masked rows cannot perturb the aggregate no matter what finite
+   content they carry — replacing the padding with garbage yields a
+   byte-identical result. This is the churn-proof property the elastic
+   runtime rests on: the compiled round's output depends only on the
+   live cohort.
+2. **Padded vs unpadded**: the pure selection/gather rules (``median``,
+   ``krum``) and the dot-product-combined ``fltrust`` reproduce the
+   unpadded cohort's aggregate byte-for-byte for every cohort size
+   ``1..2*bucket``. The sum-based rules (``mean``, ``multikrum``'s
+   final mean, ``trimmed_mean``) feed the reduction the identical live
+   terms plus exact zeros, but XLA's reduce emitter may associate the
+   wider extent differently — parity there is ~1 ulp (pinned with a
+   tight tolerance), the same reassociation two *unpadded* programs of
+   different surrounding fusion exhibit. The static, elastic-off path
+   never pads and stays byte-identical to its pre-elastic self.
+
+:class:`CompiledRoundCache` holds the ahead-of-time compiled executable
+per bucket in a true LRU (evicting an entry frees the executable, which
+a bare ``jax.jit`` cache never does) and feeds the
+``elastic.compile_cache_{hits,misses,evictions}`` telemetry the
+acceptance tests pin.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.core import telemetry
+
+
+def bucket_for(n: int, min_bucket: int = 1) -> int:
+    """Next power-of-two bucket that fits ``n`` cohort rows."""
+    if n < 1:
+        raise ValueError(f"cohort size must be >= 1, got {n}")
+    b = max(1, min_bucket)
+    while b < n:
+        b <<= 1
+    return b
+
+
+def pad_stacked(stacked_vars, weights, global_vars, bucket: int):
+    """Pad a ``[C, ...]`` stacked variables tree to ``[bucket, ...]``.
+
+    Padded rows replicate the GLOBAL variables (delta exactly zero — a
+    neutral row by construction) with aggregation weight 0. Returns
+    ``(padded_stacked, padded_weights, valid_mask)``. Works on host
+    numpy or device arrays alike (`jnp` ops; everything lands on
+    device, which is where the bucket-compiled round wants it)."""
+    c = int(np.shape(weights)[0])
+    if c > bucket:
+        raise ValueError(f"cohort {c} does not fit bucket {bucket}")
+    pad = bucket - c
+    w = jnp.asarray(weights, jnp.float32)
+    if pad == 0:
+        return stacked_vars, w, jnp.ones((bucket,), bool)
+
+    def leaf(s, g):
+        s = jnp.asarray(s)
+        fill = jnp.broadcast_to(
+            jnp.asarray(g, s.dtype)[None], (pad,) + np.shape(g)
+        )
+        return jnp.concatenate([s, fill], axis=0)
+
+    padded = jax.tree.map(leaf, stacked_vars, global_vars)
+    w = jnp.concatenate([w, jnp.zeros((pad,), jnp.float32)])
+    valid = jnp.concatenate(
+        [jnp.ones((c,), bool), jnp.zeros((pad,), bool)]
+    )
+    return padded, w, valid
+
+
+def active_mask(bucket: int, n_active) -> jax.Array:
+    """``[bucket]`` bool: the first ``n_active`` slots are live. Used
+    by the compiled sims, where ``n_active`` is a traced operand so a
+    cohort-size change never retraces the round program."""
+    return jnp.arange(bucket) < n_active
+
+
+def mask_padded(stacked_vars, n_k, msums, global_vars, live):
+    """Neutralize the padded slots of a bucketed cohort BEFORE
+    screening/aggregation: params healed to the global variables (delta
+    exactly zero), sample count zero, metric sums zero — downstream the
+    padding is indistinguishable from absent. One implementation shared
+    by the sim and sharded round bodies (their parity pin requires the
+    two to stay byte-equivalent)."""
+
+    def heal(s, g):
+        m = live.reshape((-1,) + (1,) * (s.ndim - 1))
+        return jnp.where(m, s, g[None].astype(s.dtype))
+
+    stacked_vars = jax.tree.map(heal, stacked_vars, global_vars)
+    n_k = jnp.where(live, n_k, jnp.zeros_like(n_k))
+    msums = jax.tree.map(
+        lambda v: jnp.where(
+            live.reshape((-1,) + (1,) * (v.ndim - 1)),
+            v, jnp.zeros_like(v),
+        ),
+        msums,
+    )
+    return stacked_vars, n_k, msums
+
+
+def mirror_jit_cache(round_fn, call):
+    """Invoke ``call()`` (one application of ``round_fn``) and mirror
+    the jit executable cache's hit/miss into the ``elastic.*``
+    vocabulary (docs/OBSERVABILITY.md) — churn cost must be observable.
+    Shared by the sim and sharded ``run_round`` elastic paths so the
+    accounting cannot drift between them. ``round_fn`` exposes its
+    executable count via ``_cache_size`` (models/ops jit wrapper);
+    without it the call runs unmirrored."""
+    size_fn = getattr(round_fn, "_cache_size", None)
+    before = size_fn() if size_fn is not None else None
+    out = call()
+    if before is not None:
+        if size_fn() > before:
+            telemetry.METRICS.inc("elastic.compile_cache_misses")
+        else:
+            telemetry.METRICS.inc("elastic.compile_cache_hits")
+    return out
+
+
+class CompiledRoundCache:
+    """LRU of ahead-of-time compiled executables, keyed by bucket size.
+
+    ``jax.jit`` already caches by shape, but it neither evicts nor
+    reports — an elastic server that saw 40 distinct cohort sizes would
+    silently hold 40 executables forever and nothing would tell you the
+    bucketing was (or wasn't) working. This cache lowers + compiles
+    explicitly, bounds the resident set, and counts
+    ``elastic.compile_cache_{hits,misses,evictions}``
+    (docs/OBSERVABILITY.md). Thread-safe: round closes arrive on
+    transport dispatch threads."""
+
+    def __init__(self, fn: Callable, max_entries: int = 8,
+                 static_argnums=()):
+        self._fn = fn
+        self._static_argnums = tuple(static_argnums)
+        self.max_entries = max_entries
+        self._cache: OrderedDict[int, object] = OrderedDict()
+        self._lock = threading.Lock()
+        # local mirror of the telemetry counters so tests (and callers
+        # running with the metrics plane off) can still read hit rates
+        self.stats = {"hits": 0, "misses": 0, "evictions": 0}
+
+    def __call__(self, bucket: int, *args):
+        with self._lock:
+            exe = self._cache.get(bucket)
+            if exe is not None:
+                self._cache.move_to_end(bucket)
+        if exe is None:
+            exe = (
+                jax.jit(self._fn, static_argnums=self._static_argnums)
+                .lower(*args)
+                .compile()
+            )
+            evicted = False
+            with self._lock:
+                self._cache[bucket] = exe
+                self._cache.move_to_end(bucket)
+                if len(self._cache) > self.max_entries:
+                    self._cache.popitem(last=False)
+                    evicted = True
+                self.stats["misses"] += 1
+                if evicted:
+                    self.stats["evictions"] += 1
+            telemetry.METRICS.inc("elastic.compile_cache_misses")
+            if evicted:
+                telemetry.METRICS.inc("elastic.compile_cache_evictions")
+            telemetry.RECORDER.record("elastic_compile", bucket=bucket)
+        else:
+            with self._lock:
+                self.stats["hits"] += 1
+            telemetry.METRICS.inc("elastic.compile_cache_hits")
+        if self._static_argnums:
+            dynamic = tuple(
+                a for i, a in enumerate(args)
+                if i not in self._static_argnums
+            )
+            return exe(*dynamic)
+        return exe(*args)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._cache)

@@ -55,6 +55,12 @@ class LivenessMonitor:
         self.dead: set[int] = set()
         now = time.monotonic()
         self.last_seen: dict[int, float] = {p: now for p in self.peers}
+        # per-peer watchdog generation: a revive/watch that supersedes
+        # a still-sleeping watchdog bumps it, and the old thread exits
+        # on its next wake instead of coexisting with its replacement
+        # (an unwatch→rejoin inside one interval would otherwise leak
+        # a duplicate watchdog per churn cycle)
+        self._gen: dict[int, int] = {p: 0 for p in self.peers}
         self._lock = threading.Lock()
         self._stop = threading.Event()
         mgr.transport.add_deliver_hook(self._on_deliver)
@@ -65,7 +71,7 @@ class LivenessMonitor:
         # into a world failure)
         self._threads = [
             threading.Thread(
-                target=self._run_peer, args=(p,), daemon=True,
+                target=self._run_peer, args=(p, 0), daemon=True,
                 name=f"liveness-rank{mgr.rank}-peer{p}",
             )
             for p in self.peers
@@ -104,11 +110,13 @@ class LivenessMonitor:
             self.on_dead(peer)
         return True
 
-    def _run_peer(self, peer: int) -> None:
+    def _run_peer(self, peer: int, gen: int) -> None:
         while not self._stop.wait(self.interval_s):
             if self.mgr.transport._stopped.is_set():
                 return  # actor finished without an explicit stop()
             with self._lock:
+                if self._gen.get(peer) != gen:
+                    return  # superseded by a revive/watch replacement
                 if peer in self.dead:
                     return
                 stale = (
@@ -166,12 +174,49 @@ class LivenessMonitor:
             if peer not in self.dead:
                 return
             self.dead.discard(peer)
+            # supersede the old watchdog (it may still be sleeping if
+            # the dead flag came from unwatch rather than its own
+            # staleness verdict): bump the generation so it exits on
+            # wake instead of running alongside its replacement
+            self._gen[peer] = gen = self._gen.get(peer, 0) + 1
         t = threading.Thread(
-            target=self._run_peer, args=(peer,), daemon=True,
+            target=self._run_peer, args=(peer, gen), daemon=True,
             name=f"liveness-rank{self.mgr.rank}-peer{peer}",
         )
         t.start()
         self._threads.append(t)
+
+    def watch(self, peer: int) -> None:
+        """Start monitoring a peer that was NOT part of the launch
+        world — a mid-run elastic admission (docs/FAULT_TOLERANCE.md
+        "Elastic membership"). For an already-known peer this is
+        :meth:`revive`."""
+        with self._lock:
+            known = peer in self.last_seen
+            if not known:
+                self.peers.append(peer)
+                self.last_seen[peer] = time.monotonic()
+                self._gen[peer] = 0
+        if known:
+            self.revive(peer)
+            return
+        t = threading.Thread(
+            target=self._run_peer, args=(peer, 0), daemon=True,
+            name=f"liveness-rank{self.mgr.rank}-peer{peer}",
+        )
+        t.start()
+        self._threads.append(t)
+
+    def unwatch(self, peer: int) -> None:
+        """Stop monitoring a peer that LEFT gracefully: its watchdog
+        thread exits without firing ``on_dead`` (a departure is not a
+        death), and a later :meth:`revive`/:meth:`watch` re-arms it.
+        Implemented by marking the peer dead WITHOUT the on_dead
+        callback — the watchdog loop's exit condition."""
+        with self._lock:
+            if peer not in self.last_seen:
+                return
+            self.dead.add(peer)
 
     def stop(self) -> None:
         self._stop.set()
@@ -232,9 +277,14 @@ class Manager:
         self.transport = transport
         self._handlers: dict[int, Handler] = {}
         self.liveness: LivenessMonitor | None = None
+        # why the peer FINISHed us, when it said (e.g. "evicted" —
+        # docs/FAULT_TOLERANCE.md "Elastic membership"): the deploy
+        # summary reports it so a supervisor can tell a departure BY
+        # DESIGN from an ordinary wind-down
+        self.finish_reason: str | None = None
         transport.add_observer(self)
         self.register_message_receive_handler(
-            MSG_TYPE_FINISH, lambda msg: self.finish()
+            MSG_TYPE_FINISH, self._on_finish
         )
         # liveness/handshake beacons are protocol-level: every actor
         # accepts them (their primary side effect — the last-seen
@@ -246,6 +296,10 @@ class Manager:
         self.register_message_receive_handler(
             MSG_TYPE_S2C_ACK, lambda msg: None
         )
+
+    def _on_finish(self, msg: Message) -> None:
+        self.finish_reason = msg.get("reason")
+        self.finish()
 
     def _on_heartbeat(self, msg: Message) -> None:
         """Ping/echo half of the RTT measurement: a beat carrying
@@ -347,6 +401,14 @@ class Manager:
 class ServerManager(Manager):
     """Rank-0 actor (reference ``server_manager.py:15``)."""
 
+    def client_ranks(self) -> list[int]:
+        """The client ranks this server currently serves. The default
+        is the launch world (``1..size-1``); elastic actors override it
+        with their membership ledger so broadcasts and FINISH reach
+        mid-run admissions and skip departed ranks
+        (docs/FAULT_TOLERANCE.md "Elastic membership")."""
+        return list(range(1, self.size))
+
     def broadcast(
         self,
         msg_type: int,
@@ -354,12 +416,12 @@ class ServerManager(Manager):
         ranks: Iterable[int] | None = None,
         on_send_error: Callable[[int, Exception], None] | None = None,
     ) -> None:
-        """Send ``Message(msg_type, 0, r, payload_fn(r))`` to every client
-        rank 1..size-1 (or just ``ranks``). With ``on_send_error`` a
+        """Send ``Message(msg_type, 0, r, payload_fn(r))`` to every
+        served client rank (or just ``ranks``). With ``on_send_error`` a
         failed send is reported per-rank instead of aborting the whole
         broadcast — the fault-tolerant round path treats it as a dead
         peer and keeps the cohort's survivors moving."""
-        targets = range(1, self.size) if ranks is None else ranks
+        targets = self.client_ranks() if ranks is None else ranks
         for r in targets:
             msg = Message(msg_type, self.rank, r, payload_fn(r))
             if on_send_error is None:
@@ -371,7 +433,7 @@ class ServerManager(Manager):
                 on_send_error(r, err)
 
     def finish_all(self) -> None:
-        for r in range(1, self.size):
+        for r in self.client_ranks():
             try:
                 self.send_message(
                     Message(MSG_TYPE_FINISH, self.rank, r, {})

@@ -62,6 +62,14 @@ MSG_TYPE_HEARTBEAT = 7
 # excluded until the end of the run.
 MSG_TYPE_C2S_JOIN = 8
 MSG_TYPE_S2C_WELCOME = 9
+# Elastic membership (docs/FAULT_TOLERANCE.md "Elastic membership"): a
+# client announces a GRACEFUL departure — distinct from a crash (no
+# restart budget spent, no dead-peer flight dump, no quarantine
+# suspicion). The server's membership ledger marks the rank LEFT; it may
+# JOIN again later. JOIN doubles as the mid-run ADMISSION message for
+# ranks beyond the launch world_size (the ledger assigns them a stable
+# client id and they enter the cohort at the next round boundary).
+MSG_TYPE_C2S_LEAVE = 10
 
 # Well-known payload keys (reference Message.MSG_ARG_KEY_*)
 KEY_MODEL_PARAMS = "model_params"
@@ -157,14 +165,18 @@ class Message:
         return _WIRE_MAGIC + _HDR.pack(len(meta)) + meta + frame
 
     @staticmethod
-    def decode(data: bytes) -> "Message":
-        if not data.startswith(_WIRE_MAGIC):  # legacy plain-pickle frame
+    def decode(data) -> "Message":
+        """``data`` may be any buffer (bytes/bytearray/memoryview) —
+        the sealed transports hand over a zero-copy payload view."""
+        view = memoryview(data)
+        if bytes(view[:len(_WIRE_MAGIC)]) != _WIRE_MAGIC:
+            # legacy plain-pickle frame
             msg = pickle.loads(data)
             assert isinstance(msg, Message)
             return msg
         off = len(_WIRE_MAGIC)
-        (meta_len,) = _HDR.unpack_from(data, off)
+        (meta_len,) = _HDR.unpack_from(view, off)
         off += _HDR.size
         return Message.from_parts(
-            data[off:off + meta_len], data[off + meta_len:]
+            view[off:off + meta_len], view[off + meta_len:]
         )

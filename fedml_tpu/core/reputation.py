@@ -24,6 +24,7 @@ State is two fixed-shape arrays (``scores[world]``,
 from __future__ import annotations
 
 import dataclasses
+import threading
 
 import numpy as np
 
@@ -40,12 +41,18 @@ class QuarantinePolicy:
       new``); higher = slower to trip AND slower to forgive.
     - ``warmup_rounds``: rounds at the start of a run during which
       scores accumulate but nobody trips (round-0 deltas are noisy).
+    - ``evict_after``: rounds a rank may sit in quarantine without
+      earning release before it is PERMANENTLY evicted from the
+      membership ledger (docs/FAULT_TOLERANCE.md "Elastic
+      membership"). 0 (default) = never escalate — quarantine stays
+      recoverable forever.
     """
 
     threshold: float = 0.0
     release_frac: float = 0.5
     decay: float = 0.7
     warmup_rounds: int = 1
+    evict_after: int = 0
 
     def __post_init__(self):
         if not (0.0 <= self.release_frac < 1.0):
@@ -55,6 +62,10 @@ class QuarantinePolicy:
             )
         if not (0.0 <= self.decay < 1.0):
             raise ValueError(f"decay must be in [0, 1), got {self.decay}")
+        if self.evict_after < 0:
+            raise ValueError(
+                f"evict_after must be >= 0, got {self.evict_after}"
+            )
 
     def enabled(self) -> bool:
         return self.threshold > 0
@@ -62,7 +73,15 @@ class QuarantinePolicy:
 
 class ReputationTracker:
     """Per-rank reputation for a ``size``-rank world (rank 0, the
-    server, never quarantines itself — its slots stay zero)."""
+    server, never quarantines itself — its slots stay zero).
+
+    Elastic worlds (docs/FAULT_TOLERANCE.md "Elastic membership") grow
+    past the launch ``world_size``: :meth:`ensure_size` extends the
+    arrays for a newly admitted rank with a clean slate, and
+    :meth:`load_arrays` accepts a checkpoint written by a DIFFERENT
+    world size — the restored run keeps every score the checkpoint
+    carries (leaving and rejoining, or restarting the server into a
+    smaller launch world, must never launder a reputation)."""
 
     def __init__(self, size: int, policy: QuarantinePolicy | None = None):
         self.size = size
@@ -70,6 +89,33 @@ class ReputationTracker:
         self.scores = np.zeros(size, np.float32)
         # round at which the rank was quarantined; -1 = not quarantined
         self.quarantined_at = np.full(size, -1, np.int32)
+        # elastic worlds mutate the tracker from more than one thread:
+        # an admission's ensure_size arrives on the transport dispatch
+        # thread while a round-deadline Timer (or liveness watchdog)
+        # drives observe() through the round close — without this lock
+        # an in-place observe write can land in an array concat just
+        # discarded, silently losing the reputation update
+        self._lock = threading.Lock()
+
+    def ensure_size(self, size: int) -> None:
+        """Grow the per-rank arrays to cover ``size`` ranks (new slots
+        start clean: score 0, not quarantined). Shrinking never happens
+        — a departed rank keeps its slot so a later rejoin resumes its
+        accumulated reputation."""
+        with self._lock:
+            self._ensure_size_locked(size)
+
+    def _ensure_size_locked(self, size: int) -> None:
+        if size <= self.size:
+            return
+        pad = size - self.size
+        self.scores = np.concatenate(
+            [self.scores, np.zeros(pad, np.float32)]
+        )
+        self.quarantined_at = np.concatenate(
+            [self.quarantined_at, np.full(pad, -1, np.int32)]
+        )
+        self.size = size
 
     # -- per-round update --------------------------------------------------
 
@@ -81,6 +127,10 @@ class ReputationTracker:
         "released": [...], "suspected": [...]}`` — the NEW transitions
         plus the ranks whose instant score exceeded the threshold this
         round."""
+        with self._lock:
+            return self._observe_locked(round_idx, ranks, round_scores)
+
+    def _observe_locked(self, round_idx, ranks, round_scores) -> dict:
         p = self.policy
         newly_q, released, suspected = [], [], []
         for rank, s in zip(ranks, np.asarray(round_scores, np.float32)):
@@ -122,18 +172,29 @@ class ReputationTracker:
     def state_arrays(self) -> dict[str, np.ndarray]:
         """Fixed-shape snapshot for the round checkpointer (rides the
         server's composite checkpoint payload)."""
-        return {
-            "scores": self.scores.copy(),
-            "quarantined_at": self.quarantined_at.copy(),
-        }
+        with self._lock:
+            return {
+                "scores": self.scores.copy(),
+                "quarantined_at": self.quarantined_at.copy(),
+            }
 
     def load_arrays(self, blob: dict) -> None:
-        scores = np.asarray(blob["scores"], np.float32)
-        qat = np.asarray(blob["quarantined_at"], np.int32)
-        if scores.shape != (self.size,) or qat.shape != (self.size,):
+        """Restore, tolerating a checkpoint written by a different
+        world size: a larger checkpoint grows this tracker (an elastic
+        run admitted ranks past the launch world before the crash); a
+        smaller one restores into a clean-slate prefix (the world was
+        relaunched bigger). Either way no saved score is dropped."""
+        scores = np.asarray(blob["scores"], np.float32).ravel()
+        qat = np.asarray(blob["quarantined_at"], np.int32).ravel()
+        if scores.shape != qat.shape:
             raise ValueError(
-                f"reputation checkpoint sized {scores.shape} does not "
-                f"fit a {self.size}-rank world"
+                f"reputation checkpoint arrays disagree: scores "
+                f"{scores.shape} vs quarantined_at {qat.shape}"
             )
-        self.scores = scores.copy()
-        self.quarantined_at = qat.copy()
+        saved = scores.shape[0]
+        with self._lock:
+            self._ensure_size_locked(saved)
+            self.scores = np.zeros(self.size, np.float32)
+            self.quarantined_at = np.full(self.size, -1, np.int32)
+            self.scores[:saved] = scores
+            self.quarantined_at[:saved] = qat

@@ -78,17 +78,51 @@ def add_gaussian_noise(tree_: Pytree, stddev: float, rng: jax.Array) -> Pytree:
     return jax.tree.unflatten(treedef, noisy)
 
 
-def coordinate_median(stacked: Pytree) -> Pytree:
+def coordinate_median(stacked: Pytree,
+                      valid: jax.Array | None = None) -> Pytree:
     """Coordinate-wise median over the client axis (reference
-    ``coordinate_median_agg``, ``robust_aggregation.py:57-66``)."""
-    return jax.tree.map(lambda x: jnp.median(x, axis=0), stacked)
+    ``coordinate_median_agg``, ``robust_aggregation.py:57-66``).
 
-
-def trimmed_mean(stacked: Pytree, trim_frac: float = 0.1) -> Pytree:
-    """Coordinate-wise trimmed mean (standard robust-FL baseline; not in the
-    reference but a natural companion to the median defense)."""
+    With ``valid`` (``[C]`` bool, possibly traced) the median is taken
+    over the VALID rows only — the bucket-padded elastic rounds
+    (:mod:`fedml_tpu.core.elastic`) pad the cohort with zero-weight
+    rows that must not perturb the coordinate statistics: invalid rows
+    sort to ``+inf`` and the two middle elements are gathered at the
+    dynamic valid count, so a change in that count never retraces."""
+    if valid is None:
+        return jax.tree.map(lambda x: jnp.median(x, axis=0), stacked)
+    n = jnp.sum(valid.astype(jnp.int32))
+    lo_i = (n - 1) // 2
+    hi_i = n // 2
 
     def leaf(x):
+        m = valid.reshape((-1,) + (1,) * (x.ndim - 1))
+        inf = jnp.asarray(jnp.inf, x.dtype)
+        s = jnp.sort(jnp.where(m, x, inf), axis=0)
+        lo = jnp.take(s, lo_i, axis=0)
+        hi = jnp.take(s, hi_i, axis=0)
+        # (lo + hi) / 2 == jnp.median's interpolated midpoint bit-for-
+        # bit: halving commutes with the one rounding of the sum
+        return ((lo + hi) / 2).astype(x.dtype)
+
+    return jax.tree.map(leaf, stacked)
+
+
+def trimmed_mean(stacked: Pytree, trim_frac: float = 0.1,
+                 valid: jax.Array | None = None) -> Pytree:
+    """Coordinate-wise trimmed mean (standard robust-FL baseline; not in the
+    reference but a natural companion to the median defense).
+
+    With ``valid`` the trim count derives from the VALID row count (the
+    bucket-padded elastic path): invalid rows sort to ``+inf`` and the
+    mean runs over the ``[k, n-k)`` band of the valid prefix — so the
+    masked rows are provably content-blind (they are replaced before
+    the sort and excluded from the band sum; pinned exactly in
+    ``tests/test_elastic.py``). Versus the UNPADDED static path the
+    live terms are identical but XLA may associate the wider reduce
+    differently (~1 ulp; see core/elastic.py for the parity tiers)."""
+
+    def leaf_static(x):
         c = x.shape[0]
         # clamp so at least one row survives: k >= c/2 (over-trimming a
         # small cohort) would slice an empty range and average to NaN —
@@ -99,6 +133,35 @@ def trimmed_mean(stacked: Pytree, trim_frac: float = 0.1) -> Pytree:
             return jnp.mean(x, axis=0)
         s = jnp.sort(x, axis=0)
         return jnp.mean(s[k : c - k], axis=0)
+
+    if valid is None:
+        return jax.tree.map(leaf_static, stacked)
+
+    c_max = jax.tree.leaves(stacked)[0].shape[0]
+    # trim count per possible live count, computed host-side with the
+    # SAME Python-float formula as leaf_static — deriving k in traced
+    # f32 can disagree (f32(10) * f32(0.3) rounds to 3.0000001, so the
+    # padded path would trim 3 rows where the unpadded path trims
+    # int(10 * 0.3) == 2) and break padded-vs-unpadded parity outright
+    ks = jnp.asarray(
+        [max(0, min(int(c * trim_frac), (c - 1) // 2))
+         for c in range(c_max + 1)], jnp.int32,
+    )
+    n = jnp.sum(valid.astype(jnp.int32))
+    k = ks[n]
+    idx = jnp.arange(c_max)
+    band = (idx >= k) & (idx < n - k)  # [C] rows kept after trimming
+
+    # one formula covers k == 0 too: the band is then the whole valid
+    # prefix of the sorted rows, whose sum is the plain mean's terms
+    def leaf(x):
+        m = valid.reshape((-1,) + (1,) * (x.ndim - 1))
+        inf = jnp.asarray(jnp.inf, x.dtype)
+        s = jnp.sort(jnp.where(m, x, inf), axis=0)
+        b = band.reshape((-1,) + (1,) * (x.ndim - 1))
+        return jnp.sum(
+            jnp.where(b, s, jnp.zeros((), x.dtype)), axis=0
+        ) / (n - 2 * k).astype(x.dtype)
 
     return jax.tree.map(leaf, stacked)
 
@@ -135,7 +198,8 @@ _FAR = 1e30
 
 
 def krum_scores(d2: jax.Array, num_adversaries: int,
-                valid: jax.Array | None = None) -> jax.Array:
+                valid: jax.Array | None = None,
+                n_valid: jax.Array | None = None) -> jax.Array:
     """Krum score per client: the sum of its ``C - f - 2`` smallest
     distances to OTHER clients (Blanchard et al.; lower = more central).
     Degenerate cohorts (``C <= f + 2``) fall back to the single nearest
@@ -146,44 +210,82 @@ def krum_scores(d2: jax.Array, num_adversaries: int,
     (a screening-induced DoS on the selection defenses), so distances
     to and from invalid rows count as :data:`_FAR`, pushing them to
     the bottom of every ranking while valid rows still order by their
-    real neighborhoods."""
+    real neighborhoods.
+
+    ``n_valid`` (traced scalar) switches the neighbor count to derive
+    from the VALID row count instead of the static row count — required
+    on the bucket-padded elastic path, where the padded ``C`` would
+    otherwise pull :data:`_FAR` terms into every valid row's score
+    (1e30 absorbs the real distances in f32 and the argmin degenerates
+    to row 0). Invalid rows score ``+inf`` so they can never win a
+    selection regardless of how small the valid cohort gets."""
     c = d2.shape[0]
-    k = max(1, min(c - 2 - num_adversaries, c - 1))
     if valid is not None:
         pair_ok = valid[:, None] & valid[None, :]
         pair_ok = pair_ok | jnp.eye(c, dtype=bool)  # keep self 0
         d2 = jnp.where(pair_ok, d2, _FAR)
     s = jnp.sort(d2, axis=1)  # column 0 is the exact-zero self distance
-    return jnp.sum(s[:, 1 : k + 1], axis=1)
+    if n_valid is None:
+        k = max(1, min(c - 2 - num_adversaries, c - 1))
+        return jnp.sum(s[:, 1 : k + 1], axis=1)
+    k = jnp.clip(n_valid - 2 - num_adversaries, 1,
+                 jnp.maximum(n_valid - 1, 1))
+    cols = jnp.arange(c)
+    sel = (cols >= 1) & (cols <= k)
+    scores = jnp.sum(jnp.where(sel[None, :], s, 0.0), axis=1)
+    if valid is not None:
+        scores = jnp.where(valid, scores, jnp.inf)
+    return scores
 
 
 def krum(stacked: Pytree, num_adversaries: int,
-         weights: jax.Array | None = None
+         weights: jax.Array | None = None,
+         n_valid: jax.Array | None = None
          ) -> tuple[Pytree, jax.Array, jax.Array]:
     """Krum selection: return ``(selected delta, scores, best index)``
     — the single most central client's delta IS the aggregate. Rows
-    with zero ``weights`` are never selected."""
+    with zero ``weights`` are never selected. ``n_valid`` (traced)
+    switches to the dynamic neighbor count for bucket-padded cohorts."""
     valid = None if weights is None else weights > 0
     scores = krum_scores(pairwise_sq_dists(stacked), num_adversaries,
-                         valid)
+                         valid, n_valid)
     best = jnp.argmin(scores)
     return jax.tree.map(lambda x: x[best], stacked), scores, best
 
 
 def multi_krum(stacked: Pytree, weights: jax.Array, num_adversaries: int,
-               m: int = 0) -> tuple[Pytree, jax.Array, jax.Array]:
+               m: int = 0, n_valid: jax.Array | None = None
+               ) -> tuple[Pytree, jax.Array, jax.Array]:
     """Multi-Krum: weighted mean over the ``m`` best-scored clients
     (``m = 0`` auto-resolves to ``C - f``, clamped to ``[1, C]``).
     Returns ``(aggregate, scores, selected mask)``. Zero-weight rows
     rank last and contribute nothing even if the keep count reaches
-    them (their aggregation weight is already 0)."""
+    them (their aggregation weight is already 0).
+
+    ``n_valid`` (traced) makes BOTH the neighbor count and the auto
+    keep count derive from the valid row count — on a bucket-padded
+    cohort the static ``C - f`` would keep every valid row plus padded
+    debris instead of dropping the ``f`` most suspect valid rows."""
     c = jax.tree.leaves(stacked)[0].shape[0]
     f = num_adversaries
-    m_eff = m if m > 0 else max(1, c - f)
-    m_eff = max(1, min(m_eff, c))
-    scores = krum_scores(pairwise_sq_dists(stacked), f, weights > 0)
-    _, idx = jax.lax.top_k(-scores, m_eff)
-    mask = jnp.zeros((c,), bool).at[idx].set(True)
+    scores = krum_scores(pairwise_sq_dists(stacked), f, weights > 0,
+                         n_valid)
+    if n_valid is None:
+        m_eff = m if m > 0 else max(1, c - f)
+        m_eff = max(1, min(m_eff, c))
+        _, idx = jax.lax.top_k(-scores, m_eff)
+        mask = jnp.zeros((c,), bool).at[idx].set(True)
+    else:
+        m_dyn = (jnp.asarray(m) if m > 0
+                 else jnp.maximum(1, n_valid - f))
+        m_dyn = jnp.clip(m_dyn, 1, n_valid)
+        # selection by rank: stable argsort ties break by index, the
+        # same order lax.top_k uses on the static path
+        order = jnp.argsort(scores)
+        rank = jnp.zeros((c,), jnp.int32).at[order].set(
+            jnp.arange(c, dtype=jnp.int32)
+        )
+        mask = rank < m_dyn
     w = jnp.where(mask, weights.astype(jnp.float32), 0.0)
     return T.tree_weighted_mean(stacked, w), scores, mask
 
@@ -213,7 +315,9 @@ def fltrust(stacked: Pytree, ref: Pytree, eps: float = 1e-12,
     return T.tree_unvectorize(agg_vec, ref), trust
 
 
-def anomaly_scores(stacked: Pytree) -> dict[str, jax.Array]:
+def anomaly_scores(stacked: Pytree,
+                   valid: jax.Array | None = None
+                   ) -> dict[str, jax.Array]:
     """Per-client anomaly signals over a stacked delta tree, all
     derived from one flatten + one gram matmul:
 
@@ -229,20 +333,38 @@ def anomaly_scores(stacked: Pytree) -> dict[str, jax.Array]:
       produce;
     - ``score``: the combined scalar the reputation plane accumulates:
       ``relu(l2_z) + relu(-cos_to_med) + 2 * near_duplicate``.
+
+    ``valid`` (``[C]`` bool, possibly traced) restricts every cohort
+    statistic — norm mean/std, mean/median reference vectors, nearest
+    neighbor — to the valid rows, so the bucket-padded elastic path's
+    zero-delta padding rows neither skew the z-scores nor trip the
+    near-duplicate collusion signal against each other. Scores at
+    invalid slots are meaningless and must be discarded by the caller.
     """
     eps = 1e-12
     x = flatten_clients(stacked)  # [C, D]
     c = x.shape[0]
     sq = jnp.sum(x * x, axis=1)
     norms = jnp.sqrt(sq)
-    mu = jnp.mean(norms)
-    sd = jnp.std(norms)
+    if valid is None:
+        mu = jnp.mean(norms)
+        sd = jnp.std(norms)
+        mean_vec = jnp.mean(x, axis=0)
+    else:
+        vf = valid.astype(jnp.float32)
+        n = jnp.sum(vf)
+        mu = jnp.sum(jnp.where(valid, norms, 0.0)) / n
+        sd = jnp.sqrt(
+            jnp.sum(jnp.where(valid, jnp.square(norms - mu), 0.0)) / n
+        )
+        mean_vec = jnp.sum(
+            jnp.where(valid[:, None], x, 0.0), axis=0
+        ) / n
     l2_z = (norms - mu) / jnp.maximum(sd, 1e-6)
 
-    mean_vec = jnp.mean(x, axis=0)
-    med_vec = T.tree_vectorize(coordinate_median(stacked)).astype(
-        jnp.float32
-    )
+    med_vec = T.tree_vectorize(
+        coordinate_median(stacked, valid)
+    ).astype(jnp.float32)
 
     def _cos(ref):
         rn = jnp.sqrt(jnp.sum(ref * ref))
@@ -251,6 +373,11 @@ def anomaly_scores(stacked: Pytree) -> dict[str, jax.Array]:
     gram = x @ x.T
     d2 = jnp.maximum(sq[:, None] + sq[None, :] - 2.0 * gram, 0.0)
     d2 = jnp.where(jnp.eye(c, dtype=bool), jnp.inf, d2)  # mask self
+    if valid is not None:
+        # an invalid row must neither be anyone's nearest neighbor nor
+        # find one among the other padding rows
+        pair_ok = valid[:, None] & valid[None, :]
+        d2 = jnp.where(pair_ok, d2, jnp.inf)
     nearest = jnp.sqrt(jnp.min(d2, axis=1)) if c > 1 else jnp.full(
         (c,), jnp.inf
     )
@@ -379,31 +506,48 @@ class DefensePipeline:
             if self.clip > 0 else deltas
         )
 
-    def reduce(self, deltas: Pytree, weights: jax.Array, red) -> Pytree:
+    def reduce(self, deltas: Pytree, weights: jax.Array, red,
+               valid: jax.Array | None = None) -> Pytree:
         """Aggregate stacked deltas under the configured rule. ``red``
         is the :class:`~fedml_tpu.algorithms.fedavg.Reducer` — selection
         defenses gather the full ``[C, ...]`` stack (like the median
         rule always has), so they compose with the mesh-sharded
-        runtime unchanged."""
+        runtime unchanged.
+
+        ``valid`` (``[C]`` bool, possibly traced) marks the live rows
+        of a bucket-padded cohort (:mod:`fedml_tpu.core.elastic`):
+        every rule then reduces over the valid rows only, and the
+        padded zero-weight / zero-delta rows provably cannot perturb
+        the aggregate (content-blind bitwise; see core/elastic.py for
+        the parity tiers ``tests/test_elastic.py`` pins)."""
         if self.method == "mean":
+            # padding rows carry weight 0 and delta 0: they vanish from
+            # both the weighted sum and the weight total exactly
             return red.wmean(deltas, weights)
         g = red.gather(deltas)
+        gv = None if valid is None else red.gather(valid)
+        n_valid = None if gv is None else jnp.sum(gv.astype(jnp.int32))
         if self.method == "median":
-            return coordinate_median(g)
+            return coordinate_median(g, gv)
         if self.method == "trimmed_mean":
-            return trimmed_mean(g, self.trim_frac)
+            return trimmed_mean(g, self.trim_frac, gv)
         gw = red.gather(weights)
+        if gv is not None:
+            # selection rules key eligibility off weights > 0; make the
+            # padding mask authoritative even if a live client ever
+            # reported a zero sample count
+            gw = jnp.where(gv, gw, 0.0)
         if self.method == "krum":
-            return krum(g, self.num_adversaries, gw)[0]
+            return krum(g, self.num_adversaries, gw, n_valid)[0]
         if self.method == "multikrum":
             return multi_krum(
-                g, gw, self.num_adversaries, self.multikrum_m
+                g, gw, self.num_adversaries, self.multikrum_m, n_valid
             )[0]
         if self.method == "fltrust":
             # no server root dataset in the loop: the reference delta
             # defaults to the coordinate-median of the cohort (robust
             # to a minority of adversaries by construction)
-            return fltrust(g, coordinate_median(g), weights=gw)[0]
+            return fltrust(g, coordinate_median(g, gv), weights=gw)[0]
         raise ValueError(f"unknown defense method: {self.method!r}")
 
     def postprocess(self, agg: Pytree, rng: jax.Array) -> Pytree:

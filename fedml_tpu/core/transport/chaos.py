@@ -20,6 +20,13 @@ Fault model (send-side, plus crash-on-receive):
   MQTT QoS 1 re-delivery).
 - **reorder** — the message is held back and ships after the NEXT send
   (multi-path routing).
+- **corrupt** — seeded bit-flips in the message's SEALED wire frame
+  (cosmic rays, failing NICs, buggy middleboxes). The transport codecs
+  that seal frames (tcp, pubsub — :mod:`.wire`) compute the CRC first
+  and flip after, so the receiver detects the damage, counts
+  ``transport.corrupt_frames``, and drops the frame; the retry /
+  straggler machinery heals it like a drop. The one wire failure class
+  PR 1 left uncovered.
 - **crash-at-round-N** — the first inbound message tagged with
   ``round_idx >= N`` kills this rank: either it goes silent (swallows
   all subsequent traffic, ``crash_mode="silent"``) or the whole process
@@ -45,6 +52,7 @@ from fedml_tpu.core import telemetry
 from fedml_tpu.core.message import (
     KEY_ROUND,
     MSG_TYPE_C2S_JOIN,
+    MSG_TYPE_C2S_LEAVE,
     MSG_TYPE_C2S_READY,
     MSG_TYPE_FINISH,
     MSG_TYPE_HEARTBEAT,
@@ -72,6 +80,9 @@ class FaultPolicy:
     delay_max_s: float = 0.05
     dup_prob: float = 0.0
     reorder_prob: float = 0.0
+    # per-message probability of seeded bit-flips in the sealed wire
+    # frame (detected + dropped by the CRC codecs; see module doc)
+    corrupt_prob: float = 0.0
     crash_at_round: int | None = None
     crash_mode: str = "silent"  # "silent" | "exit"
     # protected by default: FINISH (so a zero-tolerance run still
@@ -91,6 +102,7 @@ class FaultPolicy:
         MSG_TYPE_HEARTBEAT,
         MSG_TYPE_C2S_JOIN,
         MSG_TYPE_S2C_WELCOME,
+        MSG_TYPE_C2S_LEAVE,
     )
 
     def __post_init__(self):
@@ -106,6 +118,7 @@ class FaultPolicy:
             or self.delay_prob
             or self.dup_prob
             or self.reorder_prob
+            or self.corrupt_prob
             or self.crash_at_round is not None
         )
 
@@ -138,7 +151,7 @@ class ChaosTransport(BaseTransport):
         # counters for diagnostics / tests ({fault -> count})
         self.stats = {
             "sent": 0, "dropped": 0, "delayed": 0, "duplicated": 0,
-            "reordered": 0,
+            "reordered": 0, "corrupted": 0,
         }
         # the inner transport still counts wire bytes at its decode
         # site, but deliver-time telemetry (trace marks, inbox gauge)
@@ -199,12 +212,27 @@ class ChaosTransport(BaseTransport):
         with self._rng_lock:
             # fixed draw order keeps the decision stream aligned across
             # runs even when an earlier fault short-circuits
-            r_drop, r_dup, r_delay, r_reorder, r_u = (
-                self._rng.random() for _ in range(5)
+            r_drop, r_dup, r_delay, r_reorder, r_u, r_corrupt = (
+                self._rng.random() for _ in range(6)
             )
         if r_drop < p.drop_prob:
             self._stat("dropped")
             return
+        if r_corrupt < p.corrupt_prob:
+            # mark the message; the sealing codec (tcp/pubsub) flips
+            # seeded bits AFTER computing the CRC, so the receiver's
+            # checksum detects + drops the frame. The corruption seed
+            # derives from the draw itself — no extra RNG consumption,
+            # fully replayable. Composes with dup/delay (the marker
+            # rides every copy).
+            msg.chaos_corrupt = int(r_corrupt * (1 << 31))
+            self._stat("corrupted")
+        elif getattr(msg, "chaos_corrupt", None) is not None:
+            # a RETRY re-sends the same Message object: clear a stale
+            # marker so this send's draw decides its fate — otherwise a
+            # once-corrupted message is re-corrupted on every retry and
+            # the retry machinery can never heal the loss
+            del msg.chaos_corrupt
         if r_reorder < p.reorder_prob:
             swap = None
             with self._held_lock:

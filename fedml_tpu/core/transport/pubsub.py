@@ -32,11 +32,14 @@ store behind tiny interfaces:
 from __future__ import annotations
 
 import os
+import sys
 import threading
 import uuid
 from typing import Callable
 
+from fedml_tpu.core import telemetry
 from fedml_tpu.core.message import KEY_MODEL_PARAMS, Message
+from fedml_tpu.core.transport import wire
 from fedml_tpu.core.transport.base import BaseTransport
 
 KEY_BLOB = "model_params_blob_key"
@@ -141,7 +144,27 @@ class PubSubTransport(BaseTransport):
 
     def _on_message(self, topic: str, payload: bytes) -> None:
         self.note_receive(len(payload))
-        self.deliver(self._inflate(Message.decode(payload)))
+        try:
+            data = wire.open_sealed(payload)
+        except wire.CorruptFrameError:
+            # damaged between publisher and subscriber (the broker
+            # daemon routes payloads untouched, so the seal is
+            # end-to-end): count + drop — QoS-0 semantics make the
+            # drop legal and the layers above heal it
+            telemetry.METRICS.inc("transport.corrupt_frames")
+            telemetry.RECORDER.record(
+                "corrupt_frame", rank=self.rank, nbytes=len(payload)
+            )
+            return
+        except wire.WireVersionError as err:
+            telemetry.flight_dump(
+                "wire_version_mismatch", rank=self.rank,
+                detail=str(err),
+            )
+            print(f"rank {self.rank}: {err}", file=sys.stderr)
+            self.stop()
+            return
+        self.deliver(self._inflate(Message.decode(data)))
 
     def _deflate(self, msg: Message) -> Message:
         return msg  # plain MQTT: whole message on the topic
@@ -150,9 +173,14 @@ class PubSubTransport(BaseTransport):
         return msg
 
     def send_message(self, msg: Message) -> None:
-        data = self._deflate(msg).encode()
-        self.note_send(msg, len(data))
-        self.bus.publish(self._topic_for(msg.receiver), data)
+        sealed = wire.seal(self._deflate(msg).encode())
+        corrupt_seed = getattr(msg, "chaos_corrupt", None)
+        if corrupt_seed is not None:
+            # chaos 'corrupt' fault: flip seeded bits AFTER sealing so
+            # the subscriber-side CRC catches the damage
+            sealed = wire.flip_bits(sealed, corrupt_seed)
+        self.note_send(msg, len(sealed))
+        self.bus.publish(self._topic_for(msg.receiver), sealed)
 
 
 class PubSubBlobTransport(PubSubTransport):

@@ -1,20 +1,29 @@
-"""TCP socket transport: length-prefixed message frames.
+"""TCP socket transport: length-prefixed, sealed message frames.
 
 The DCN-class control-plane transport (reference analog: the gRPC backend,
 ``fedml_core/distributed/communication/gRPC/grpc_comm_manager.py:22-98`` —
 each process runs a server, send opens a channel to ``ip_config[receiver]``).
 Here: each rank runs one accept loop; sends use pooled persistent
-connections; frames are ``8-byte big-endian length || pickled Message``.
+connections; frames are ``8-byte big-endian length || sealed payload``
+where the seal is the protocol-version byte + CRC32 of
+:mod:`fedml_tpu.core.transport.wire`. A CRC mismatch (bit-flip in
+flight, or the chaos ``corrupt`` fault) is counted
+(``transport.corrupt_frames``) and DROPPED — the retry/heartbeat/
+straggler machinery heals it like any loss; a protocol-version mismatch
+(rolling-restart skew) fails the rank loudly instead of garbling a
+pytree (docs/FAULT_TOLERANCE.md).
 """
 
 from __future__ import annotations
 
 import socket
 import struct
+import sys
 import threading
 
 from fedml_tpu.core import telemetry
 from fedml_tpu.core.message import Message
+from fedml_tpu.core.transport import wire
 from fedml_tpu.core.transport.base import BaseTransport
 from fedml_tpu.core.transport.retry import RetryPolicy, call_with_retry
 
@@ -100,7 +109,33 @@ class TcpTransport(BaseTransport):
                 if data is None:
                     return
                 self.note_receive(_HDR.size + length)
-                self.deliver(Message.decode(data))
+                try:
+                    payload = wire.open_sealed(data)
+                except wire.CorruptFrameError:
+                    # damaged in flight: count + drop; the length
+                    # prefix framed the stream correctly, so the next
+                    # frame parses — and the fault-tolerance layer
+                    # above heals the loss (retries re-send syncs,
+                    # straggler rounds close without the result)
+                    telemetry.METRICS.inc("transport.corrupt_frames")
+                    telemetry.RECORDER.record(
+                        "corrupt_frame", rank=self.rank, nbytes=length
+                    )
+                    continue
+                except wire.WireVersionError as err:
+                    # rolling-restart skew: every further frame from
+                    # this peer is unparseable — fail THIS rank loudly
+                    # (stop unblocks the actor's run loop into its
+                    # incomplete-run error) instead of silently
+                    # dropping traffic forever
+                    telemetry.flight_dump(
+                        "wire_version_mismatch", rank=self.rank,
+                        detail=str(err),
+                    )
+                    print(f"rank {self.rank}: {err}", file=sys.stderr)
+                    self.stop()
+                    return
+                self.deliver(Message.decode(payload))
 
     # -- send side ---------------------------------------------------------
     def _rank_lock(self, rank: int) -> threading.Lock:
@@ -111,9 +146,27 @@ class TcpTransport(BaseTransport):
             return lock
 
     def send_message(self, msg: Message) -> None:
-        data = msg.encode()
-        self.note_send(msg, _HDR.size + len(data))
-        self._send_wire(msg.receiver, _HDR.pack(len(data)) + data)
+        payload = msg.encode()
+        corrupt_seed = getattr(msg, "chaos_corrupt", None)
+        if corrupt_seed is not None:
+            # the chaos 'corrupt' fault marked this message: flip
+            # seeded bits in the SEALED frame (after the CRC was
+            # computed, so the receiver's checksum catches it)
+            sealed = wire.flip_bits(
+                wire.seal(payload), corrupt_seed
+            )
+            frame = _HDR.pack(len(sealed)) + sealed
+        else:
+            # single join: length prefix + 5-byte seal + payload — the
+            # payload is the multi-MB model frame on sync/result sends,
+            # so an intermediate sealed copy is a real cost
+            frame = b"".join((
+                _HDR.pack(wire.SEAL_OVERHEAD + len(payload)),
+                wire.seal_header(payload),
+                payload,
+            ))
+        self.note_send(msg, len(frame))
+        self._send_wire(msg.receiver, frame)
 
     def _evict(self, rank: int) -> None:
         with self._lock:

@@ -113,6 +113,24 @@ class DeployConfig:
     # rides the round checkpoint so it survives server restarts
     quarantine_threshold: float = 0.0
     quarantine_decay: float = 0.7
+    # rounds a rank may sit in quarantine before it is PERMANENTLY
+    # evicted from the membership ledger (0 = never escalate)
+    quarantine_evict_after: int = 0
+    # -- elastic membership (docs/FAULT_TOLERANCE.md "Elastic
+    # membership"): client rank — after submitting the result for this
+    # round, announce a graceful LEAVE and wind down (None = stay for
+    # the whole run)
+    leave_after_round: int | None = None
+    # server rank, set by the SUPERVISOR on a restart: ranks whose final
+    # summary reported a graceful LEAVE (or eviction) — they are never
+    # respawned, so even if the restored checkpoint predates the
+    # departure the barrier must not wait for them (the ledger is
+    # brought up to date before the required set is computed)
+    presumed_left: tuple[int, ...] = ()
+    # like presumed_left but for ranks whose summary said "evicted":
+    # the restored ledger must mark them EVICTED, not LEFT — a LEFT
+    # rank may JOIN back, a ban must survive the restart
+    presumed_evicted: tuple[int, ...] = ()
     # -- telemetry (docs/OBSERVABILITY.md) ---------------------------------
     # directory for THIS rank's artifacts: trace_rank<r>.json span dump,
     # metrics_rank<r>.json snapshot, flight_rank<r>_*.json crash rings;
@@ -155,6 +173,17 @@ def _make_transport(dep: DeployConfig) -> BaseTransport:
             dep.backend, dep.rank, ip_config=dep.ip_config
         )
     if dep.fault is not None and dep.fault.enabled():
+        if dep.fault.corrupt_prob and backend not in (
+                "TCP", "PUBSUB", "MQTT", "PUBSUB_BLOB", "MQTT_S3"):
+            import sys as _sys
+
+            print(
+                "warning: --fault_corrupt flips bits in the sealed "
+                "tcp/pubsub frame codecs; the "
+                f"{dep.backend} backend does not seal frames, so the "
+                "corrupt fault is inert here",
+                file=_sys.stderr,
+            )
         transport = ChaosTransport(transport, dep.fault)
     return transport
 
@@ -216,17 +245,63 @@ def _serve_with_ready_barrier(
     (docs/FAULT_TOLERANCE.md "Recovery")."""
     ready: set[int] = set()
     started = threading.Event()
+    # the barrier's required set: normally the launch world, but a
+    # server RESTORED from an elastic checkpoint serves the ledger's
+    # world — a rank that gracefully LEFT before the crash must not be
+    # waited on (it is never coming back), and a mid-run admission that
+    # outlived the crash completes the barrier like any member
+    for r in dep.presumed_left:
+        # the supervisor SAW these ranks depart (their final summary
+        # said "left") and will never respawn them; if the restored
+        # checkpoint predates the departure the ledger still lists
+        # them ACTIVE and the barrier would wait forever — bring the
+        # ledger up to date first
+        leave = getattr(server, "on_peer_leave", None)
+        if leave is not None:
+            leave(r)
+    for r in dep.presumed_evicted:
+        # same, but the departure was a PERMANENT ban: replaying it as
+        # a LEAVE would let the banned (possibly adversarial) rank
+        # JOIN back in — re-evict so the restored ledger rejects it.
+        # notify=False: the rank's process already exited with
+        # status "evicted"; a FINISH to its gone endpoint would only
+        # sit out the transport retry budget and delay the barrier
+        evict = getattr(server, "evict_rank", None)
+        if evict is not None:
+            evict(r, notify=False)
+    required = (set(server.client_ranks())
+                - set(dep.presumed_left)
+                - set(dep.presumed_evicted))
+    # an empty required set normally means an actor without a ledger —
+    # fall back to waiting for the launch world. But when departures
+    # EXPLAIN the emptiness (every restored member departed by design)
+    # the launch ranks are never respawned: falling back would wedge
+    # the relaunch forever — wait for the next admission instead
+    # (note_alive grows the set as JOINs are admitted)
+    all_departed = (not required
+                    and bool(dep.presumed_left or dep.presumed_evicted))
+    if not required and not all_departed:
+        required = set(range(1, dep.world_size))
 
     def note_alive(sender: int) -> None:
         # observers run on the single dispatch thread — no lock needed
-        if not (1 <= sender < dep.world_size) or started.is_set():
+        if started.is_set():
             return
+        if sender not in required:
+            if all_departed and sender in server.client_ranks():
+                # admitted after the all-departed barrier was computed:
+                # this rank IS the world now — it completes the barrier
+                required.add(sender)
+            else:
+                return
         ready.add(sender)
-        if len(ready) >= dep.world_size - 1:
+        if len(ready) >= len(required):
             started.set()
             if dep.heartbeats:
                 server.enable_liveness(
-                    range(1, dep.world_size),
+                    # re-read: ranks admitted DURING the barrier window
+                    # are watched from kickoff too
+                    server.client_ranks(),
                     interval_s=dep.heartbeat_interval_s,
                     timeout_s=dep.heartbeat_timeout_s,
                     on_dead=_server_dead_peer_cb(server),
@@ -248,13 +323,57 @@ def _serve_with_ready_barrier(
         note_alive(msg.sender)
 
     def on_join(msg: Message) -> None:
+        join = getattr(server, "on_peer_join",
+                       getattr(server, "on_peer_rejoin", None))
+        membership = getattr(server, "membership", None)
+        if (membership is not None
+                and msg.sender in membership.get("evicted", ())):
+            # a restored ledger may ban a rank INSIDE the launch world
+            # (--quarantine_evict_after before the restart): its JOIN is
+            # never ACKed, pre-kickoff included — ACKing would park the
+            # banned client waiting forever for a sync it will never
+            # get, masquerading as a healthy member
+            return
         if started.is_set():
-            rejoin = getattr(server, "on_peer_rejoin", None)
-            if rejoin is not None:
-                rejoin(msg.sender)  # WELCOMEs + revives the rank
+            if join is not None:
+                # unified membership entry: rejoin for active members
+                # (WELCOMEd with the current round's sync), mid-run
+                # ADMISSION for ranks beyond the launch world, silent
+                # rejection for evicted ranks
+                # (docs/FAULT_TOLERANCE.md "Elastic membership")
+                if join(msg.sender) == "admitted":
+                    # an admission is not synced until the NEXT round
+                    # boundary: ACK now so the joiner's announce loop
+                    # stops waiting instead of racing ready_timeout
+                    # against an in-flight round that may outlast it
+                    # (without heartbeats the ACK is its only contact)
+                    try:
+                        server.send_message(
+                            Message(MSG_TYPE_S2C_ACK, 0, msg.sender, {})
+                        )
+                    except Exception:
+                        pass  # joiner endpoint flapped; it re-JOINs
                 return
-            # actor without mid-run rejoin (SplitNN's strictly
+            # actor without mid-run membership (SplitNN's strictly
             # sequential rounds): ACK so the client stops announcing
+            on_ready(msg)
+            return
+        returning = (membership is not None
+                     and msg.sender in membership.get("left", ()))
+        if join is not None and (
+                not (1 <= msg.sender < dep.world_size) or returning):
+            # a beyond-world rank announcing BEFORE kickoff — or an
+            # in-world rank a RESTORED ledger marks LEFT (departed
+            # before the server was SIGKILLed, relaunched now): admit
+            # it into the ledger (first cohort slot at the next round
+            # boundary); without the re-admission the LEFT rank would
+            # be ACKed but never served — parked forever outside
+            # client_ranks(). It neither counts toward nor blocks the
+            # launch barrier, which still waits for the configured
+            # world. An EVICTED rank is never ACKed — its announce
+            # loop times out loudly on its side.
+            if join(msg.sender) == "rejected":
+                return
         on_ready(msg)
 
     # NOTE: no per-deploy heartbeat handler anymore. A client's liveness
@@ -424,6 +543,7 @@ def _run_fedavg_rank(cfg: ExperimentConfig, dep: DeployConfig) -> dict:
             quarantine=QuarantinePolicy(
                 threshold=dep.quarantine_threshold,
                 decay=dep.quarantine_decay,
+                evict_after=dep.quarantine_evict_after,
             ),
         )
         try:
@@ -479,14 +599,32 @@ def _run_fedavg_rank(cfg: ExperimentConfig, dep: DeployConfig) -> dict:
             # force and which ranks ended the run quarantined
             "defense": cfg.fed.robust_method,
             "quarantined": server.quarantined_ranks,
+            # the elastic-membership verdicts (docs/FAULT_TOLERANCE.md
+            # "Elastic membership"): who ended the run active / left /
+            # evicted — mid-run admissions show up as active ranks
+            # beyond the launch world
+            "membership": server.membership,
+            "elastic": bool(cfg.fed.elastic_buckets),
             **metrics,
         }
 
     client = FedAvgClientActor(
-        dep.rank, dep.world_size, transport, model, data, cfg
+        dep.rank, dep.world_size, transport, model, data, cfg,
+        leave_after_round=dep.leave_after_round,
     )
     _run_client(client, dep)
-    return {"role": "client", "rank": dep.rank, "status": "finished"}
+    return {
+        "role": "client",
+        "rank": dep.rank,
+        # "left": announced a graceful LEAVE; "evicted": the server
+        # FINISHed it out of the world permanently; either way the
+        # Supervisor must never respawn or reactivate this rank
+        "status": (
+            "left" if client.left.is_set()
+            else "evicted" if client.finish_reason == "evicted"
+            else "finished"
+        ),
+    }
 
 
 def _run_splitnn_rank(cfg: ExperimentConfig, dep: DeployConfig) -> dict:
@@ -665,6 +803,15 @@ class Supervisor:
         self.restarts: dict[int, int] = {r: 0 for r in self.specs}
         self.respawns: dict[int, int] = {r: 0 for r in self.specs}
         self.exited: dict[int, int] = {}  # rank -> rc for clean exits
+        # ranks whose clean exit was a graceful LEAVE (summary status
+        # "left"): departed BY DESIGN, never respawned or reactivated —
+        # the ledger keeps the departure across server restarts and the
+        # restored barrier will not wait for them
+        self.departed: set[int] = set()
+        # the subset of departed whose status was "evicted": a restarted
+        # server must re-EVICT them (not mark them LEFT) so the ban
+        # survives a checkpoint that predates it
+        self.evicted: set[int] = set()
         self.log_paths: dict[int, list[str]] = {r: [] for r in self.specs}
         self._fhs: list = []
         self._pending: dict[int, float] = {}  # rank -> respawn-at time
@@ -724,6 +871,43 @@ class Supervisor:
             and proc.poll() is None
         )
 
+    def _client_departed(self, rank: int) -> str | None:
+        """The rank's departure status if its last incarnation reported
+        a departure BY DESIGN — its final stdout line is the run.py
+        summary JSON with ``status: "left"`` (graceful LEAVE) or
+        ``"evicted"`` (the server permanently banned it and FINISHed it
+        out of the world); either way the rank must stay gone
+        (docs/FAULT_TOLERANCE.md "Elastic membership"). None for an
+        ordinary finish (or no readable summary)."""
+        try:
+            with open(self.log_paths[rank][-1], "rb") as f:
+                f.seek(0, os.SEEK_END)
+                f.seek(max(0, f.tell() - 4096))
+                tail = f.read().decode("utf-8", "replace")
+        except Exception:
+            return None
+        for line in reversed(tail.strip().splitlines()):
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            # stderr rides the same stream (_spawn merges it): a
+            # '{'-prefixed fragment AFTER the summary (interpreter-
+            # shutdown noise, dict reprs) must not mask the summary —
+            # keep scanning earlier lines past anything that is not a
+            # status-carrying JSON object
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            status = (
+                obj.get("status") if isinstance(obj, dict) else None
+            )
+            if status is not None:
+                return (
+                    status if status in ("left", "evicted") else None
+                )
+        return None
+
     def _respawn_finished_client(self, rank: int) -> None:
         """Schedule a respawn for a client whose clean exit was judged
         premature (it obeyed a doomed server incarnation's FINISH).
@@ -745,6 +929,20 @@ class Supervisor:
 
     def _on_exit(self, rank: int, rc: int) -> None:
         if rc == 0:
+            status = (
+                self._client_departed(rank) if rank != 0 else None
+            )
+            if status is not None:
+                # graceful LEAVE or eviction: this clean exit is a
+                # mid-run departure BY DESIGN, not an obeyed FINISH —
+                # stays gone even if the server is mid-restart (the
+                # rank-0 respawn argv carries the departure so the
+                # restored barrier will not wait for it)
+                self.departed.add(rank)
+                if status == "evicted":
+                    self.evicted.add(rank)
+                self.exited[rank] = 0
+                return
             if rank == 0 or self._server_healthy():
                 # the server completing, or a client winding down while
                 # a never-crashed server finishes its post-run work
@@ -777,8 +975,10 @@ class Supervisor:
         if rank == 0:
             # the dying server may have FINISHed clients into clean
             # exits moments before it crashed — reactivate them; its
-            # restarted incarnation needs them back at the barrier
-            for r in [r for r in self.exited if r != 0]:
+            # restarted incarnation needs them back at the barrier.
+            # Gracefully-LEFT ranks stay gone: the ledger says so.
+            for r in [r for r in self.exited
+                      if r != 0 and r not in self.departed]:
                 del self.exited[r]
                 self._respawn_finished_client(r)
 
@@ -805,9 +1005,24 @@ class Supervisor:
                     if now >= at:
                         del self._pending[rank]
                         spec = self.specs[rank]
-                        self._spawn(
-                            rank, spec.restart_argv or spec.argv
-                        )
+                        argv = list(spec.restart_argv or spec.argv)
+                        if rank == 0 and self.departed:
+                            # the restored checkpoint may predate a
+                            # departure: tell the restarted server
+                            # which ranks are gone BY DESIGN so its
+                            # barrier does not wait forever for ranks
+                            # this supervisor will never respawn —
+                            # evictions separately, so the ledger
+                            # re-bans instead of marking merely LEFT
+                            left = sorted(self.departed - self.evicted)
+                            if left:
+                                argv += ["--presumed_left",
+                                         *(str(r) for r in left)]
+                            if self.evicted:
+                                argv += ["--presumed_evicted", *(
+                                    str(r) for r in sorted(self.evicted)
+                                )]
+                        self._spawn(rank, argv)
                 for rank, proc in list(self.procs.items()):
                     rc = proc.poll()
                     if rc is None:

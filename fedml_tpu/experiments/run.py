@@ -115,6 +115,41 @@ def parse_args(argv=None) -> tuple[ExperimentConfig, argparse.Namespace]:
     p.add_argument("--quarantine_decay", type=float, default=0.7,
                    help="EWMA memory for the reputation score "
                         "(higher = slower to trip and to forgive)")
+    p.add_argument("--quarantine_evict_after", type=int, default=0,
+                   help="rounds a rank may sit in quarantine without "
+                        "earning release before it is PERMANENTLY "
+                        "evicted from the membership ledger (0 = "
+                        "never; docs/FAULT_TOLERANCE.md 'Elastic "
+                        "membership')")
+    # -- elastic membership / shape bucketing ------------------------------
+    p.add_argument("--elastic", action="store_true",
+                   help="elastic world: pad cohorts to power-of-two "
+                        "buckets so membership churn (mid-run client "
+                        "admission via JOIN from ranks >= world_size, "
+                        "graceful --leave_after_round departures) "
+                        "costs a compile-cache hit instead of an XLA "
+                        "recompile; rides config.json as "
+                        "fed.elastic_buckets")
+    p.add_argument("--leave_after_round", type=int, default=None,
+                   help="client rank: after submitting the result for "
+                        "this round, announce a graceful LEAVE and "
+                        "exit 0 (no dead-peer suspicion, no restart "
+                        "budget spent)")
+    p.add_argument("--presumed_left", type=int, nargs="*", default=(),
+                   help="server rank, set by the supervisor on a "
+                        "restart: ranks whose final summary reported a "
+                        "departure — marked LEFT before the ready "
+                        "barrier even when the restored checkpoint "
+                        "predates the LEAVE (they are never respawned, "
+                        "so waiting would hang the relaunch)")
+    p.add_argument("--presumed_evicted", type=int, nargs="*",
+                   default=(),
+                   help="server rank, set by the supervisor on a "
+                        "restart: ranks whose final summary reported "
+                        "an EVICTION — re-evicted before the ready "
+                        "barrier even when the restored checkpoint "
+                        "predates the ban (marking them merely LEFT "
+                        "would let the banned rank JOIN back in)")
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--repetitions", type=int, default=1)
     p.add_argument("--run_name", type=str, default=None)
@@ -212,6 +247,12 @@ def parse_args(argv=None) -> tuple[ExperimentConfig, argparse.Namespace]:
                    help="per-message duplication probability")
     p.add_argument("--fault_reorder", type=float, default=0.0,
                    help="per-message reorder probability")
+    p.add_argument("--fault_corrupt", type=float, default=0.0,
+                   help="per-message payload bit-flip probability "
+                        "(seeded; the CRC32 frame checksum on the "
+                        "tcp/pubsub codecs detects and drops the "
+                        "frame — transport.corrupt_frames — and the "
+                        "retry/straggler machinery heals the loss)")
     p.add_argument("--fault_crash_round", type=int, default=None,
                    help="crash this rank on the first message tagged "
                         "with round_idx >= N")
@@ -269,6 +310,7 @@ def parse_args(argv=None) -> tuple[ExperimentConfig, argparse.Namespace]:
             robust_num_adversaries=a.defense_num_adversaries,
             robust_multikrum_m=a.defense_multikrum_m,
             robust_trim_frac=a.defense_trim_frac,
+            elastic_buckets=True if a.elastic else None,
         ),
         adversary=rep(
             cfg.adversary,
@@ -295,7 +337,8 @@ def parse_args(argv=None) -> tuple[ExperimentConfig, argparse.Namespace]:
     try:
         DefensePipeline.from_fed(cfg.fed)
         QuarantinePolicy(threshold=a.quarantine_threshold,
-                         decay=a.quarantine_decay)
+                         decay=a.quarantine_decay,
+                         evict_after=a.quarantine_evict_after)
         check_fednova_compat(cfg.fed.algorithm, cfg.fed.robust_method)
     except ValueError as err:
         raise SystemExit(str(err))
@@ -330,6 +373,7 @@ def _fault_policy(a) -> "FaultPolicy | None":
         delay_max_s=a.fault_delay_max,
         dup_prob=a.fault_dup,
         reorder_prob=a.fault_reorder,
+        corrupt_prob=a.fault_corrupt,
         crash_at_round=a.fault_crash_round,
         crash_mode=a.fault_crash_mode,
     )
@@ -351,8 +395,20 @@ def _deploy_config(a) -> "DeployConfig":
         raise SystemExit("--role client requires --rank >= 1")
     if a.role == "server" and rank != 0:
         raise SystemExit("server is always rank 0")
-    if a.role == "client" and not (1 <= rank < a.world_size):
-        raise SystemExit("client rank must be in [1, world_size)")
+    if a.role == "client" and rank < 1:
+        raise SystemExit("client rank must be >= 1")
+    if (a.role == "client" and rank >= a.world_size
+            and not a.elastic):
+        # a rank beyond the launch world is a mid-run ADMISSION — it
+        # only makes sense against an elastic server, whose membership
+        # ledger will admit the JOIN (docs/FAULT_TOLERANCE.md "Elastic
+        # membership"); a static server drops it and this client would
+        # time out
+        raise SystemExit(
+            f"client rank {rank} is outside the launch world "
+            f"[1, {a.world_size}); joining a running world mid-run "
+            "requires --elastic (on BOTH the server and this client)"
+        )
     # simulator-only knobs are silently inert under --role — say so
     # loudly rather than letting the user think they took effect
     if a.repetitions != 1:
@@ -395,6 +451,10 @@ def _deploy_config(a) -> "DeployConfig":
         fault=_fault_policy(a),
         quarantine_threshold=a.quarantine_threshold,
         quarantine_decay=a.quarantine_decay,
+        quarantine_evict_after=a.quarantine_evict_after,
+        leave_after_round=a.leave_after_round,
+        presumed_left=tuple(a.presumed_left),
+        presumed_evicted=tuple(a.presumed_evicted),
     )
 
 
@@ -510,6 +570,15 @@ def main(argv=None) -> int:
             "warning: --quarantine_threshold is a deployment flag and "
             "is ignored by the simulator (use --role/--supervise; "
             "--defense still applies here)",
+            file=sys.stderr,
+        )
+    if a.leave_after_round is not None:
+        # departure is an actor-protocol event (MSG_TYPE_C2S_LEAVE);
+        # the compiled simulator has no per-rank processes to depart
+        print(
+            "warning: --leave_after_round is a deployment flag and is "
+            "ignored by the simulator (use --role client; "
+            "set_cohort_size drives churn in the simulator)",
             file=sys.stderr,
         )
     # adversary injection is wired into the FedAvgSim round program;

@@ -39,6 +39,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from fedml_tpu.core.compat import shard_map
 
 from fedml_tpu.config import ExperimentConfig
+from fedml_tpu.core import elastic as E
 from fedml_tpu.core import random as R
 from fedml_tpu.data.federated import FederatedData, shard_client_banks
 from fedml_tpu.algorithms.base import (
@@ -93,6 +94,17 @@ class ShardedFedAvg(FedAvgSim):
             "client->shard placement)"
         )
         self.cohort_per_shard = cohort // self.n_client_shards
+        # elastic shape bucketing (core/elastic.py): each shard's slice
+        # of the cohort is padded to ITS power-of-two bucket, so a
+        # cohort-size change (set_cohort_size) is a masked-row change,
+        # not a recompile — the sharded twin of FedAvgSim's bucketing
+        if cfg.fed.elastic_buckets:
+            self.bucket_per_shard = min(
+                E.bucket_for(self.cohort_per_shard),
+                data.num_clients // self.n_client_shards,
+            )
+        else:
+            self.bucket_per_shard = self.cohort_per_shard
 
         # FedAvgSim.__init__ builds the single-device local_update; our
         # _prepare_data override keeps the global arrays host-side and
@@ -123,9 +135,34 @@ class ShardedFedAvg(FedAvgSim):
             if self.n_data_shards == 1
             and cfg.train.cohort_fused
             and cohort_update_supported(model, cfg.train)
+            # the widened cohort network bakes the per-shard cohort
+            # into its shapes — elastic bucketing uses the vmapped path
+            and not self._elastic
             else None
         )
         self._round_fn = jax.jit(self._sharded_round, donate_argnums=(0,))
+
+    def set_cohort_size(self, n: int) -> None:
+        """Elastic cohort change for the sharded runtime: ``n`` must
+        divide evenly over the clients axis and each shard's slice must
+        fit the compiled per-shard bucket."""
+        if not self._elastic:
+            raise ValueError(
+                "set_cohort_size requires FedConfig(elastic_buckets="
+                "True)"
+            )
+        if n % self.n_client_shards != 0:
+            raise ValueError(
+                f"cohort size {n} must divide evenly over the "
+                f"{self.n_client_shards}-way clients mesh axis"
+            )
+        per = n // self.n_client_shards
+        if not (1 <= per <= self.bucket_per_shard):
+            raise ValueError(
+                f"per-shard cohort {per} does not fit the compiled "
+                f"per-shard bucket {self.bucket_per_shard}"
+            )
+        self._n_active = n
 
     def _prepare_data(self, data, cfg):
         """Training data lives ONLY in the per-shard banks (per-device HBM
@@ -143,24 +180,28 @@ class ShardedFedAvg(FedAvgSim):
         )
         assert self.banks.max_client_samples == self.arrays.max_client_samples
 
-    def _sharded_round(self, state: ServerState, banks):
+    def _sharded_round(self, state: ServerState, banks, n_active=None):
         cfg = self.cfg.fed
         rkey = R.round_key(self.root_key, state.round)
         ckey = jax.random.fold_in(rkey, 0)
         K = banks.clients_per_shard
+        Kb = self.bucket_per_shard
 
         cspec = P(self.client_axis)  # shard banks; replicate over data axis
         rep = P()
         red = psum_reducer(self.client_axis)
 
-        def shard_fn(state, x, y, idx, mask):
+        def shard_fn(state, x, y, idx, mask, *maybe_n):
             # leading shard axis arrives with extent 1 inside the shard
             x, y = x[0], y[0]
             idx, mask = idx[0], mask[0]
+            n_act = maybe_n[0] if maybe_n else None
             shard = jax.lax.axis_index(self.client_axis)
             # stratified cohort: this shard samples its own clients (LOCAL
-            # ids); keys use GLOBAL client ids so the host mirror matches
-            local = R.sample_stratum(ckey, shard, K, self.cohort_per_shard)
+            # ids); keys use GLOBAL client ids so the host mirror matches.
+            # Under elastic bucketing the shard samples its full BUCKET
+            # and a traced per-shard live count masks the padded slots.
+            local = R.sample_stratum(ckey, shard, K, Kb)
             ckeys = jax.vmap(
                 lambda c: R.client_key(rkey, shard * K + c)
             )(local)
@@ -177,6 +218,15 @@ class ShardedFedAvg(FedAvgSim):
                     self.local_update, in_axes=(None, 0, 0, None, None, 0)
                 )(state.variables, idx[local], mask[local], x, y, ckeys)
 
+            live = None
+            if n_act is not None:
+                live = E.active_mask(
+                    Kb, n_act // self.n_client_shards
+                )
+                stacked_vars, n_k, msums = E.mask_padded(
+                    stacked_vars, n_k, msums, state.variables, live
+                )
+
             new_state = server_update(
                 cfg,
                 self.cfg.train,
@@ -187,6 +237,7 @@ class ShardedFedAvg(FedAvgSim):
                 n_k,
                 rkey,
                 red,
+                valid=live,
             )
             reduced = jax.tree.map(
                 lambda v: jax.lax.psum(jnp.sum(v), self.client_axis), msums
@@ -195,14 +246,29 @@ class ShardedFedAvg(FedAvgSim):
             metrics = {"train_loss": fin["loss"], "train_acc": fin["acc"]}
             return new_state, metrics
 
+        in_specs = (rep, cspec, cspec, cspec, cspec)
+        operands = (state, banks.x, banks.y, banks.idx, banks.mask)
+        if n_active is not None:
+            # the live count is a REPLICATED operand (not a closure):
+            # closed-over tracers under shard_map are version-fragile
+            in_specs += (rep,)
+            operands += (n_active,)
         new_state, metrics = shard_map(
             shard_fn,
             mesh=self.mesh,
-            in_specs=(rep, cspec, cspec, cspec, cspec),
+            in_specs=in_specs,
             out_specs=(rep, rep),
             check_vma=False,
-        )(state, banks.x, banks.y, banks.idx, banks.mask)
+        )(*operands)
         return new_state, metrics
 
     def run_round(self, state):
-        return self._round_fn(state, self.banks)
+        if not self._elastic:
+            return self._round_fn(state, self.banks)
+        return E.mirror_jit_cache(
+            self._round_fn,
+            lambda: self._round_fn(
+                state, self.banks,
+                jnp.asarray(self._n_active, jnp.int32),
+            ),
+        )

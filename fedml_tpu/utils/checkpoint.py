@@ -48,6 +48,27 @@ class RoundCheckpointer:
     def latest_round(self) -> int | None:
         return self._mgr.latest_step()
 
+    def restore_raw(self) -> tuple[Any, int]:
+        """Template-free restore of the latest checkpoint: ``(raw
+        nested dict of numpy arrays, next_round)``, or ``(None, 0)``
+        when no checkpoint exists. This is the elastic-world entry
+        (docs/FAULT_TOLERANCE.md "Elastic membership"): the deploy
+        server's composite payload carries VARIABLE-length membership /
+        reputation arrays that a shape-templated ``restore_or`` cannot
+        express — the caller reassembles typed state with
+        :func:`from_savable` per component and adapts array sizes
+        itself."""
+        step = self._mgr.latest_step()
+        if step is None:
+            return None, 0
+        # explicit template-free StandardRestore: a bare restore(step)
+        # on a manager that never saved has no handler registered for
+        # the item and raises KeyError on current orbax
+        return (
+            self._mgr.restore(step, args=ocp.args.StandardRestore()),
+            step + 1,
+        )
+
     def restore_or(self, init_state: Any) -> tuple[Any, int]:
         """Return (state, next_round): the restored latest checkpoint if one
         exists, else ``(init_state, 0)``.
@@ -85,10 +106,12 @@ class RoundCheckpointer:
                 raw = self._mgr.restore(step)
                 if (
                     isinstance(raw, dict)
-                    and set(raw) == {"server", "reputation"}
+                    and {"server", "reputation"} <= set(raw)
+                    # tolerate later composite additions (membership)
+                    and set(raw) <= {"server", "reputation",
+                                     "membership"}
                     and not (isinstance(template, dict)
-                             and set(template) == {"server",
-                                                   "reputation"})
+                             and "server" in template)
                 ):
                     # deploy-server composite restored by a sim-shaped
                     # caller: the round state is the "server" payload
@@ -180,6 +203,30 @@ def _migrate_scopes(template: Any, blob: Any):
         spare.remove(matches[0])
     return {k: _migrate_scopes(template[k], blob[src])
             for k, src in out.items()}
+
+
+def from_savable(template: Any, blob: Any):
+    """Public face of :func:`_from_savable`: rebuild typed state (e.g.
+    a ``ServerState``) from one component of a raw
+    :meth:`RoundCheckpointer.restore_raw` payload. Falls back to the
+    same strict structure migration ``restore_or`` applies (legacy
+    ``Conv_N`` scope names), so the raw path loses none of the
+    template path's compatibility."""
+    try:
+        return _from_savable(template, blob)
+    except (KeyError, TypeError, ValueError) as err:
+        try:
+            migrated = _migrate_scopes(_to_savable(template), blob)
+        except Exception:
+            raise err
+        import warnings
+
+        warnings.warn(
+            "checkpoint component did not match its template directly "
+            "(legacy scope names); restored via structure migration",
+            stacklevel=2,
+        )
+        return _from_savable(template, migrated)
 
 
 def _from_savable(template: Any, blob: Any):

@@ -1,0 +1,172 @@
+"""CI smoke: an elastic gRPC world grows and shrinks mid-run.
+
+Drives the elastic-membership contract end to end over real sockets
+(docs/FAULT_TOLERANCE.md "Elastic membership"): a 1-server + 2-client
+gRPC world runs with ``--elastic``; once the world is demonstrably past
+round 0, a THIRD client (rank 3 — beyond the launch ``world_size``) is
+spawned and must be admitted mid-run with its stable client id; client
+rank 2 LEAVEs gracefully after round 3 (clean exit 0, no dead-peer
+suspicion). The run must complete every round, the server summary must
+record the admission (rank 3 active) and the departure (rank 2 left)
+with no dead peers, and the round function must have compiled at most
+once per distinct cohort bucket (cohorts 2 and 3 -> buckets 2 and 4 ->
+``elastic.compile_cache_misses <= 2``).
+
+Usage::
+
+    python scripts/elastic_smoke.py OUT_DIR
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ROUNDS = 8
+LEAVE_AFTER = 3
+
+
+def _free_ports(n):
+    socks = [socket.socket() for _ in range(n)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def main(out_dir: str) -> int:
+    os.makedirs(out_dir, exist_ok=True)
+    cfg = {
+        "data": {"dataset": "fake_mnist", "num_clients": 3,
+                 "batch_size": 32, "partition_method": "homo", "seed": 0},
+        "model": {"name": "lr", "num_classes": 10,
+                  "input_shape": [28, 28, 1]},
+        "train": {"lr": 0.1, "epochs": 1},
+        "fed": {"algorithm": "fedavg", "num_rounds": ROUNDS,
+                "clients_per_round": 3, "eval_every": ROUNDS},
+        "seed": 0,
+        "run_name": "elastic",
+        "out_dir": out_dir,
+    }
+    cfg_path = os.path.join(out_dir, "cfg.json")
+    with open(cfg_path, "w") as f:
+        json.dump(cfg, f)
+    ports = _free_ports(4)  # the late joiner needs an address too
+    ip_path = os.path.join(out_dir, "ip.json")
+    with open(ip_path, "w") as f:
+        json.dump({str(r): ["127.0.0.1", ports[r]] for r in range(4)}, f)
+    telemetry_dir = os.path.join(out_dir, "telemetry")
+    base = [sys.executable, "-m", "fedml_tpu.experiments.run",
+            "--config", cfg_path, "--backend", "grpc",
+            "--world_size", "3", "--ip_config", ip_path,
+            "--ready_timeout", "120", "--elastic",
+            "--checkpoint_every", "1",
+            "--telemetry_dir", telemetry_dir,
+            "--heartbeat_interval", "0.5", "--heartbeat_timeout", "10",
+            "--quorum_fraction", "0.5", "--round_deadline", "60"]
+    env = _env()
+
+    def spawn(role, rank=None, extra=()):
+        argv = [*base, "--role", role, *extra]
+        if rank is not None:
+            argv += ["--rank", str(rank)]
+        return subprocess.Popen(argv, env=env, cwd=REPO,
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT, text=True)
+
+    procs = {
+        1: spawn("client", 1),
+        2: spawn("client", 2,
+                 extra=("--leave_after_round", str(LEAVE_AFTER))),
+    }
+    server = spawn("server")
+
+    # admit the LATE JOINER once the world is provably past round 0
+    # (checkpoint cadence doubles as the progress signal)
+    ckpt_dir = os.path.join(out_dir, "elastic", "ckpt")
+    deadline = time.monotonic() + 240
+    late = None
+    while late is None and time.monotonic() < deadline:
+        if server.poll() is not None:
+            out = server.communicate()[0]
+            for p in procs.values():
+                p.kill()
+            raise SystemExit(
+                f"server exited rc={server.returncode} before the "
+                f"late joiner could be spawned:\n{out}"
+            )
+        steps = []
+        if os.path.isdir(ckpt_dir):
+            steps = [int(d) for d in os.listdir(ckpt_dir) if d.isdigit()]
+        if steps:
+            late = spawn("client", 3)
+            procs[3] = late
+        time.sleep(0.05)
+    if late is None:
+        server.kill()
+        for p in procs.values():
+            p.kill()
+        raise SystemExit("round-0 checkpoint never appeared")
+
+    s_out = server.communicate(timeout=300)[0]
+    outs = {}
+    for r, p in procs.items():
+        try:
+            outs[r] = p.communicate(timeout=60)[0]
+        except subprocess.TimeoutExpired:
+            p.kill()
+            outs[r] = p.communicate()[0]
+    if server.returncode != 0:
+        raise SystemExit(f"server failed rc={server.returncode}:\n{s_out}")
+    summary = json.loads(s_out.strip().splitlines()[-1])
+
+    assert summary["rounds"] == ROUNDS, summary
+    assert summary["elastic"] is True, summary
+    # the admission: rank 3 (beyond the launch world) ended ACTIVE
+    assert 3 in summary["membership"]["active"], summary
+    # the departure: rank 2 ended LEFT, never suspected dead
+    assert summary["membership"]["left"] == [2], summary
+    assert summary["dead_peers"] == [], summary
+    assert procs[2].returncode == 0, outs[2]
+    leaver = json.loads(outs[2].strip().splitlines()[-1])
+    assert leaver["status"] == "left", leaver
+
+    # the compile pin: cohorts 2 and 3 -> buckets 2 and 4 -> at most
+    # two round-fn compiles for the whole churn schedule
+    with open(os.path.join(telemetry_dir, "metrics_rank0.json")) as f:
+        counters = json.load(f).get("counters", {})
+    misses = counters.get("elastic.compile_cache_misses", 0)
+    hits = counters.get("elastic.compile_cache_hits", 0)
+    assert 1 <= misses <= 2, counters
+    assert hits >= ROUNDS - misses, counters
+    assert counters.get("membership.joins", 0) >= 1, counters
+    assert counters.get("membership.leaves", 0) >= 1, counters
+
+    print(json.dumps({
+        "elastic_smoke": "ok",
+        "rounds": summary["rounds"],
+        "membership": summary["membership"],
+        "compile_cache": {"misses": misses, "hits": hits},
+        "loss": summary.get("loss"),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) != 2:
+        raise SystemExit("usage: elastic_smoke.py OUT_DIR")
+    sys.exit(main(sys.argv[1]))

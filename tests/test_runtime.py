@@ -219,8 +219,11 @@ def test_pubsub_blob_swaps_model_params(tmp_path):
         Message(MSG_TYPE_S2C_SYNC_MODEL, 0, 1, {"model_params": params,
                                                 "round_idx": 3})
     )
-    # control-plane payload carries the key, NOT the params
-    wire = Message.decode(seen_topics[0])
+    # control-plane payload carries the key, NOT the params (the frame
+    # on the topic is sealed: version byte + CRC32, core/transport/wire)
+    from fedml_tpu.core.transport import wire as wirecodec
+
+    wire = Message.decode(wirecodec.open_sealed(seen_topics[0]))
     assert wire.get("model_params") is None
     assert wire.get(KEY_BLOB) is not None
     assert wire.get("model_params_url", "").startswith("blob://")
