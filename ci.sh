@@ -110,6 +110,55 @@ echo "== elastic smoke: mid-run admission + graceful LEAVE =="
 # (docs/FAULT_TOLERANCE.md "Elastic membership")
 JAX_PLATFORMS=cpu python scripts/elastic_smoke.py "$OUT/elastic"
 
+echo "== perf smoke: --profile_rounds device-time breakdown + perf.* gauges =="
+# a tiny CPU sim with --profile_rounds 2 must leave (a) a per-round
+# device-time breakdown artifact whose captures actually contained XLA
+# ops, (b) live perf.* gauges and p50/p95/p99 round-latency percentiles
+# in the metrics artifact, and (c) a non-empty metrics time-series
+# (docs/OBSERVABILITY.md "Performance observability")
+JAX_PLATFORMS=cpu python -m fedml_tpu.experiments.run \
+  --algorithm fedavg --dataset fake_mnist --model lr \
+  --client_num_in_total 4 --client_num_per_round 2 --comm_round 3 \
+  --epochs 1 --batch_size 16 --num_classes 10 --input_shape 28 28 1 \
+  --profile_rounds 2 --metrics_interval 0.2 \
+  --out_dir "$OUT/perf" --run_name perf_smoke \
+  --telemetry_dir "$OUT/perf/telemetry" > "$OUT/perf_smoke.json"
+python - "$OUT/perf/telemetry" <<'EOF'
+import json, os, sys
+tdir = sys.argv[1]
+perf = json.load(open(os.path.join(tdir, "perf_rank0.json")))
+assert len(perf["rounds"]) == 2, perf["rounds"]
+for bd in perf["rounds"]:
+    assert bd["window_s"] > 0, bd
+    for k in ("compute_s", "collective_s", "host_s", "idle_s"):
+        assert bd[k] >= 0, bd
+    assert bd["n_device_ops"] > 0, bd  # XLA ops were captured + parsed
+metrics = json.load(open(os.path.join(tdir, "metrics_rank0.json")))
+g = metrics["gauges"]
+assert "perf.rounds_per_s" in g and "perf.profile.compute_frac" in g, g
+h = metrics["histograms"]["perf.round_wall_s"]
+assert all(k in h for k in ("p50", "p95", "p99")), h
+rows = [json.loads(l)
+        for l in open(os.path.join(tdir, "metrics_rank0.jsonl"))]
+assert rows and "histograms" in rows[-1], "metrics time-series empty"
+print(f"perf smoke ok: {len(perf['rounds'])} profiled rounds, "
+      f"compute_frac={perf['mean']['compute_frac']:.3f}, "
+      f"{len(rows)} time-series rows")
+EOF
+
+echo "== bench_diff (advisory): newest two BENCH artifacts =="
+# regression comparator over the last two driver BENCH records —
+# advisory only (the artifacts may legitimately span a TPU-down round,
+# which bench_diff reports as skipped fallback records, never compares)
+B_NEW=$(ls BENCH_r*.json 2>/dev/null | sort | tail -1)
+B_OLD=$(ls BENCH_r*.json 2>/dev/null | sort | tail -2 | head -1)
+if [ -n "$B_OLD" ] && [ "$B_OLD" != "$B_NEW" ]; then
+  python scripts/bench_diff.py "$B_OLD" "$B_NEW" \
+    || echo "(advisory bench_diff failed — non-fatal)"
+else
+  echo "fewer than two BENCH_r*.json artifacts; diff skipped"
+fi
+
 echo "== 2/3 smoke matrix (tiny runs) =="
 # one process for the whole matrix: same CLI argv surface via
 # run.main(argv), but jax/backend startup and compile caches paid once

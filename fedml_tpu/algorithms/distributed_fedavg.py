@@ -211,6 +211,10 @@ class FedAvgServerActor(ServerManager):
         self.root_key = jax.random.key(cfg.seed)
         self.round_idx = 0
         self._round_t0 = time.monotonic()
+        # perf observability (core/perf.py, docs/OBSERVABILITY.md
+        # "Performance observability"): the idle-gap signal fires its
+        # flight-recorder event once per process, not once per round
+        self._idle_gap_flagged = False
         self._results: dict[int, tuple[dict, float]] = {}
         self._lock = threading.Lock()
         self.on_round_done = on_round_done
@@ -1075,6 +1079,7 @@ class FedAvgServerActor(ServerManager):
             "round_close", round=closed_idx, results=len(results),
             dead_peers=dead if dead is not None else [],
         )
+        t_agg0 = time.monotonic()
         included, stacked = self._score_and_exclude(results, closed_idx)
         if stacked is None:
             stacked = T.tree_stack([results[r][0] for r in included])
@@ -1106,6 +1111,35 @@ class FedAvgServerActor(ServerManager):
                 rkey,
                 local_reducer(),
             )
+        if m.enabled:
+            # server-side device-time accounting (core/perf.py; the
+            # accounting Smart-NIC FL serving work optimizes against,
+            # arxiv 2307.06561): how much of the round the server's
+            # chip actually worked vs sat waiting on the wire. The
+            # block_until_ready makes agg time mean execution, not
+            # dispatch — metrics-enabled runs only; the off path stays
+            # async exactly as before.
+            jax.block_until_ready(jax.tree.leaves(self.state.variables))
+            agg_s = time.monotonic() - t_agg0
+            wall_s = max(time.monotonic() - self._round_t0, 1e-9)
+            m.observe("perf.agg_wall_s", agg_s)
+            m.gauge("perf.host_wait_s", max(0.0, wall_s - agg_s))
+            agg_frac = min(1.0, agg_s / wall_s)
+            m.gauge("perf.agg_frac", agg_frac)
+            if agg_frac < 0.005:
+                # the deploy-path twin of the sims' dispatch-bound
+                # detector: >99.5% of the round is client/transport
+                # wait — the aggregator's device is idle-gapped
+                m.inc("perf.idle_gap_rounds")
+                if not self._idle_gap_flagged:
+                    self._idle_gap_flagged = True
+                    telemetry.RECORDER.record(
+                        "perf_idle_gap", round=closed_idx,
+                        agg_s=round(agg_s, 6), wall_s=round(wall_s, 6),
+                        note="aggregation occupies <0.5% of the round; "
+                             "the server device is idle waiting on "
+                             "clients/transport",
+                    )
         if self._ckpt is not None and (
             (closed_idx + 1) % self.checkpoint_every == 0
             or closed_idx + 1 >= self.cfg.fed.num_rounds

@@ -545,19 +545,46 @@ class FedAvgSim:
 
     def run(self, metrics_sink=None) -> ServerState:
         """Round loop (reference ``fedavg_api.train``,
-        ``standalone/fedavg/fedavg_api.py:40-81``)."""
+        ``standalone/fedavg/fedavg_api.py:40-81``). With
+        ``cfg.fed.profile_rounds > 0`` the perf-observability layer
+        (core/perf.py) rides along: jax-profiler capture windows around
+        the first K rounds (device-time breakdown) and live ``perf.*``
+        gauges — round rate, MFU from the shared analytic cost model,
+        and the dispatch-bound detector — for every round. The round
+        wall time is taken AFTER the metric host conversion forces the
+        device, so it measures execution, not dispatch."""
+        import time as _time
+
+        from fedml_tpu.core import perf as P
+
         state = self.init()
-        for r in range(self.cfg.fed.num_rounds):
-            state, train_m = self.run_round(state)
-            train_m = consume_round_counters(dict(train_m))
-            record = {"round": r, **{k: float(v) for k, v in train_m.items()}}
-            if (r + 1) % self.cfg.fed.eval_every == 0 or (
-                r == self.cfg.fed.num_rounds - 1
-            ):
-                test_m = self.evaluate_global(state)
-                record.update(
-                    {"test_acc": test_m["acc"], "test_loss": test_m["loss"]}
-                )
-            if metrics_sink is not None:
-                metrics_sink.log(record)
+        profiler, monitor = P.build_sim_perf(self)
+        try:
+            for r in range(self.cfg.fed.num_rounds):
+                t0 = _time.perf_counter()
+                if profiler is not None:
+                    profiler.start_round(r)
+                state, train_m = self.run_round(state)
+                train_m = consume_round_counters(dict(train_m))
+                record = {
+                    "round": r,
+                    **{k: float(v) for k, v in train_m.items()},
+                }
+                if profiler is not None:
+                    profiler.end_round(r)
+                if monitor is not None:
+                    monitor.note_round(_time.perf_counter() - t0)
+                if (r + 1) % self.cfg.fed.eval_every == 0 or (
+                    r == self.cfg.fed.num_rounds - 1
+                ):
+                    test_m = self.evaluate_global(state)
+                    record.update(
+                        {"test_acc": test_m["acc"],
+                         "test_loss": test_m["loss"]}
+                    )
+                if metrics_sink is not None:
+                    metrics_sink.log(record)
+        finally:
+            if profiler is not None:
+                profiler.finish()
         return state

@@ -131,6 +131,13 @@ class FedConfig:
     # default: the static path stays byte-identical to its
     # pre-elastic self.
     elastic_buckets: bool = False
+    # performance observability (core/perf.py, docs/OBSERVABILITY.md
+    # "Performance observability"): capture jax.profiler windows around
+    # the first K compiled rounds and parse each into a device-time
+    # breakdown (compute/collective/host/idle), with live perf.* gauges
+    # (round rate, MFU, dispatch-bound detector) for the whole run.
+    # 0 = off (no capture, no gauges, no extra cost-analysis compile).
+    profile_rounds: int = 0
 
 
 @dataclasses.dataclass(frozen=True)

@@ -56,14 +56,64 @@ from typing import Any
 from fedml_tpu.core.tracing import Tracer
 
 
+def percentiles_from_histogram(
+    h: dict[str, Any], qs: tuple[float, ...] = (0.5, 0.95, 0.99)
+) -> dict[str, float]:
+    """Estimate quantiles from a histogram's power-of-two buckets.
+
+    The target rank is located in the cumulative bucket counts and
+    linearly interpolated inside its bucket ``(2^(k-1), 2^k]``, with
+    the interpolation range clamped to the observed ``[min, max]``.
+
+    **Error bound**: the estimate is EXACT whenever the selected
+    bucket's value range collapses — single-observation histograms and
+    any histogram whose observations all share one value (min == max
+    clamps the bucket to a point). Otherwise the error is bounded by
+    the selected bucket's width: for power-of-two buckets that means
+    the estimate is within a factor of 2 of the true quantile (and
+    tighter near the min/max clamps). Good enough for SLO monitoring
+    (p99 round latency alarming on 2x regressions), not for
+    microsecond-accurate timing — use the trace dumps for that.
+    """
+    count = h.get("count", 0)
+    buckets = h.get("buckets", {})
+    if not count or not buckets:
+        return {}
+    items = sorted(
+        (int(k.split("^", 1)[1]), c) for k, c in buckets.items()
+    )
+    hmin = h.get("min", float("-inf"))
+    hmax = h.get("max", float("inf"))
+    out: dict[str, float] = {}
+    for q in qs:
+        target = q * count
+        cum = 0
+        for k, c in items:
+            prev, cum = cum, cum + c
+            if cum >= target:
+                lo = 0.0 if k <= -20 else 2.0 ** (k - 1)
+                hi = 2.0 ** k
+                lo = min(max(lo, hmin), hmax)
+                hi = max(min(hi, hmax), hmin)
+                frac = (target - prev) / c if c else 0.0
+                out[f"p{round(q * 100):d}"] = lo + (hi - lo) * frac
+                break
+    return out
+
+
 class MetricsRegistry:
     """Thread-safe counters / gauges / histograms.
 
     Names are flat dotted strings (vocabulary in docs/OBSERVABILITY.md).
     Histograms keep count/sum/min/max plus power-of-two bucket counts —
-    enough for a round-latency distribution without per-sample storage.
-    All writes no-op while ``enabled`` is False, so the disabled hot
-    path is one attribute check.
+    enough for a round-latency distribution without per-sample storage;
+    ``snapshot()`` adds bucket-interpolated ``p50``/``p95``/``p99``
+    estimates per histogram (:func:`percentiles_from_histogram` states
+    the error bound — exact for single-valued histograms, within the
+    2x bucket width otherwise), which is how a long-lived server
+    reports round-latency SLOs without per-sample storage. All writes
+    no-op while ``enabled`` is False, so the disabled hot path is one
+    attribute check.
     """
 
     def __init__(self, enabled: bool = True):
@@ -113,13 +163,20 @@ class MetricsRegistry:
             return self._counters.get(name, 0)
 
     def snapshot(self) -> dict[str, Any]:
-        """Deep-ish copy safe to mutate / serialize."""
+        """Deep-ish copy safe to mutate / serialize. Histogram entries
+        carry estimated ``p50``/``p95``/``p99`` alongside the raw
+        buckets (see :func:`percentiles_from_histogram` for the
+        estimation error bound)."""
         with self._lock:
             return {
                 "counters": dict(self._counters),
                 "gauges": dict(self._gauges),
                 "histograms": {
-                    k: {**v, "buckets": dict(v["buckets"])}
+                    k: {
+                        **v,
+                        "buckets": dict(v["buckets"]),
+                        **percentiles_from_histogram(v),
+                    }
                     for k, v in self._hists.items()
                 },
             }
@@ -200,6 +257,12 @@ TRACER: Tracer | None = None
 
 _DIR: str | None = None
 _RANK = 0
+# periodic metrics time-series flush (docs/OBSERVABILITY.md
+# "Performance observability"): a daemon thread appending snapshot rows
+# to metrics_rank<r>.jsonl so a long-lived server reports round-latency
+# SLOs over time instead of only an at-exit snapshot
+_TS_STOP: threading.Event | None = None
+_TS_THREAD: threading.Thread | None = None
 # incarnation suffix ("" for a rank's first process; "_i<n>" for a
 # supervised restart, chosen in configure() so a restarted rank never
 # overwrites the artifacts its predecessor flushed —
@@ -257,12 +320,27 @@ def default_dir(out_dir: str, run_name: str) -> str:
     return os.path.join(out_dir, run_name, "telemetry")
 
 
+def artifact_dir() -> str | None:
+    """The configured telemetry directory (None while disabled) — where
+    satellite layers (the perf profiler's capture windows and
+    breakdown artifact, core/perf.py) put their files so everything
+    about one run lands in one place."""
+    return _DIR
+
+
+def rank_tag() -> str:
+    """This process's artifact-name stem (``rank<r>`` plus the
+    incarnation suffix a supervised restart gets)."""
+    return f"rank{_RANK}{_SUFFIX}"
+
+
 def configure(
     telemetry_dir: str | None = None,
     rank: int = 0,
     trace: bool = True,
     jax_profiler: bool = False,
     flight_capacity: int = 1024,
+    metrics_interval: float | None = None,
 ) -> None:
     """Enable telemetry for THIS process (idempotent).
 
@@ -273,7 +351,10 @@ def configure(
     - a ``telemetry_dir`` additionally arms the flight recorder, the
       crash hooks (sys/threading excepthook -> flight dump), and the
       exit flush that writes ``trace_rank<r>.json`` +
-      ``metrics_rank<r>.json``.
+      ``metrics_rank<r>.json``;
+    - ``metrics_interval`` (seconds, with a dir) starts the periodic
+      time-series flush: append-only ``metrics_rank<r>.jsonl`` rows
+      (:func:`start_metrics_timeseries`).
     """
     global TRACER, _DIR, _RANK, _SUFFIX
     _RANK = rank
@@ -314,6 +395,63 @@ def configure(
             RECORDER._ring, maxlen=flight_capacity
         )
         _install_hooks()
+        if metrics_interval:
+            start_metrics_timeseries(metrics_interval)
+
+
+def _timeseries_path() -> str | None:
+    if _DIR is None:
+        return None
+    return os.path.join(_DIR, f"metrics_rank{_RANK}{_SUFFIX}.jsonl")
+
+
+def _append_timeseries_row() -> None:
+    """One snapshot row (histograms compacted: percentiles kept, raw
+    buckets dropped — the at-exit ``metrics_rank<r>.json`` carries the
+    full shape)."""
+    path = _timeseries_path()
+    if path is None:
+        return
+    snap = METRICS.snapshot()
+    row = {
+        "ts": time.time(),
+        "rank": _RANK,
+        "counters": snap["counters"],
+        "gauges": snap["gauges"],
+        "histograms": {
+            k: {kk: vv for kk, vv in v.items() if kk != "buckets"}
+            for k, v in snap["histograms"].items()
+        },
+    }
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps(row, default=repr) + "\n")
+    except OSError:
+        pass
+
+
+def start_metrics_timeseries(interval_s: float) -> None:
+    """Start the periodic metrics flush for this process (idempotent;
+    needs a configured telemetry dir). Every ``interval_s`` seconds a
+    snapshot row — counters, gauges, histograms with their
+    p50/p95/p99 — is APPENDED to ``metrics_rank<r>.jsonl``, so a
+    long-lived deployment's round-latency SLO is a time series, not
+    only the at-exit state (the ``.json`` snapshot stays the
+    latest-state artifact). The thread is a daemon and dies with the
+    process; :func:`shutdown` stops it and writes one final row."""
+    global _TS_STOP, _TS_THREAD
+    if _DIR is None or interval_s <= 0 or _TS_THREAD is not None:
+        return
+    stop = threading.Event()
+
+    def loop():
+        while not stop.wait(interval_s):
+            _append_timeseries_row()
+
+    t = threading.Thread(target=loop, daemon=True,
+                         name="metrics-timeseries")
+    _TS_STOP, _TS_THREAD = stop, t
+    t.start()
 
 
 def flush_metrics() -> None:
@@ -333,20 +471,29 @@ def flush_metrics() -> None:
 
 def flush() -> None:
     """Write the per-rank trace dump and metrics snapshot now (also runs
-    at interpreter exit once a telemetry dir is configured)."""
+    at interpreter exit once a telemetry dir is configured). With the
+    time-series flush armed, one final row is appended too — the tail
+    of the series always reflects the end state."""
     if _DIR is None:
         return
     if TRACER is not None and TRACER.events:
         TRACER.dump(
             os.path.join(_DIR, f"trace_rank{_RANK}{_SUFFIX}.json")
         )
+    if _TS_THREAD is not None:
+        _append_timeseries_row()
     flush_metrics()
 
 
 def shutdown() -> None:
     """Flush, then return to the all-disabled state (test isolation)."""
-    global TRACER, _DIR, _SUFFIX
+    global TRACER, _DIR, _SUFFIX, _TS_STOP, _TS_THREAD
+    if _TS_STOP is not None:
+        _TS_STOP.set()
+        if _TS_THREAD is not None:
+            _TS_THREAD.join(timeout=2.0)
     flush()
+    _TS_STOP = _TS_THREAD = None
     METRICS.enabled = False
     METRICS.reset()
     RECORDER.enabled = False

@@ -138,6 +138,11 @@ class DeployConfig:
     telemetry_dir: str | None = None
     trace: bool = False  # span tracing without (or in addition to) a dir
     trace_jax: bool = False  # wrap spans in jax.profiler.TraceAnnotation
+    # periodic metrics time-series flush: seconds between snapshot rows
+    # appended to metrics_rank<r>.jsonl (None = off; the round-latency
+    # SLO surface of a long-lived server — histograms carry p50/p95/p99
+    # — docs/OBSERVABILITY.md "Performance observability")
+    metrics_interval: float | None = None
 
 
 def load_ip_config(path: str) -> dict[int, tuple[str, int]]:
@@ -1076,13 +1081,15 @@ class Supervisor:
 
 def run_role(cfg: ExperimentConfig, dep: DeployConfig) -> dict:
     """Run THIS process's rank to completion; returns the rank summary."""
-    if dep.telemetry_dir or dep.trace or dep.trace_jax:
+    if (dep.telemetry_dir or dep.trace or dep.trace_jax
+            or dep.metrics_interval):
         telemetry.configure(
             # --trace without a dir still gets dumps, in the run dir
             telemetry_dir=dep.telemetry_dir
             or telemetry.default_dir(cfg.out_dir, cfg.run_name),
             rank=dep.rank,
             jax_profiler=dep.trace_jax,
+            metrics_interval=dep.metrics_interval,
         )
     algo = cfg.fed.algorithm
     if algo in FEDAVG_FAMILY:

@@ -410,7 +410,31 @@ class Experiment:
 
     @staticmethod
     def _round_loop(sim, cfg, sink, state, start_round, ckpt):
+        import time as _time
+
+        from fedml_tpu.core import perf as P
+
+        # perf observability (core/perf.py): same wiring as
+        # FedAvgSim.run for sims the generic loop drives
+        # (checkpointable runs, run_round-protocol sims). Inert unless
+        # cfg.fed.profile_rounds > 0.
+        profiler, monitor = P.build_sim_perf(sim)
+        try:
+            Experiment._instrumented_loop(
+                sim, cfg, sink, state, start_round, ckpt, profiler,
+                monitor, _time,
+            )
+        finally:
+            if profiler is not None:
+                profiler.finish()
+
+    @staticmethod
+    def _instrumented_loop(sim, cfg, sink, state, start_round, ckpt,
+                           profiler, monitor, _time):
         for r in range(start_round, cfg.fed.num_rounds):
+            t0 = _time.perf_counter()
+            if profiler is not None:
+                profiler.start_round(r)
             with telemetry.maybe_span("sim_round", round=r):
                 if state is None:  # host-driven sims (HeteroFedGDKD)
                     m = sim.run_round()
@@ -433,6 +457,13 @@ class Experiment:
                 m = consume_round_counters(dict(m))
                 record.update({k: _f(v) for k, v in m.items()
                                if _scalar(v)})
+            # the scalar conversion above forced the round's metrics to
+            # host, so the capture window and wall time cover the
+            # device execution, not just the dispatch
+            if profiler is not None:
+                profiler.end_round(r)
+            if monitor is not None:
+                monitor.note_round(_time.perf_counter() - t0)
             if (r + 1) % cfg.fed.eval_every == 0 or (
                 r == cfg.fed.num_rounds - 1
             ):

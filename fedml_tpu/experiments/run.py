@@ -177,6 +177,23 @@ def parse_args(argv=None) -> tuple[ExperimentConfig, argparse.Namespace]:
                         "jax.profiler.TraceAnnotation so device "
                         "timelines line up with host spans in a jax "
                         "profile")
+    # -- performance observability (docs/OBSERVABILITY.md) -----------------
+    p.add_argument("--profile_rounds", type=int, default=None,
+                   help="capture a jax.profiler window around each of "
+                        "the first K compiled rounds and parse it into "
+                        "a per-round device-time breakdown (compute/"
+                        "collective/host/idle) under "
+                        "<telemetry_dir>/jax_profile/, plus live "
+                        "perf.* gauges (round rate, MFU, dispatch-"
+                        "bound detector) for the whole run; composes "
+                        "with --trace_jax (span annotations land "
+                        "inside the captures). Implies telemetry.")
+    p.add_argument("--metrics_interval", type=float, default=None,
+                   help="seconds between periodic metrics snapshots "
+                        "appended to metrics_rank<r>.jsonl in the "
+                        "telemetry dir (round-latency SLO time "
+                        "series: histograms carry p50/p95/p99); "
+                        "implies telemetry")
     # -- process-separated deployment (reference mpirun/run_server.sh
     # surface: one OS process per rank; scripts/run_distributed.sh is the
     # localhost launcher) --------------------------------------------------
@@ -311,6 +328,7 @@ def parse_args(argv=None) -> tuple[ExperimentConfig, argparse.Namespace]:
             robust_multikrum_m=a.defense_multikrum_m,
             robust_trim_frac=a.defense_trim_frac,
             elastic_buckets=True if a.elastic else None,
+            profile_rounds=a.profile_rounds,
         ),
         adversary=rep(
             cfg.adversary,
@@ -411,6 +429,15 @@ def _deploy_config(a) -> "DeployConfig":
         )
     # simulator-only knobs are silently inert under --role — say so
     # loudly rather than letting the user think they took effect
+    if a.profile_rounds:
+        print(
+            "warning: --profile_rounds capture windows cover the "
+            "simulator paths; under --role the aggregation path "
+            "reports perf.agg_wall_s / perf.host_wait_s / idle-gap "
+            "signals instead (docs/OBSERVABILITY.md 'Performance "
+            "observability')",
+            file=sys.stderr,
+        )
     if a.repetitions != 1:
         print(
             "warning: --repetitions is a simulator flag and is ignored "
@@ -434,6 +461,7 @@ def _deploy_config(a) -> "DeployConfig":
         telemetry_dir=a.telemetry_dir,
         trace=a.trace,
         trace_jax=a.trace_jax,
+        metrics_interval=a.metrics_interval,
         backend=a.backend,
         ip_config=load_ip_config(a.ip_config) if a.ip_config else None,
         broker=broker,
@@ -595,7 +623,8 @@ def main(argv=None) -> int:
             f"{sorted(_ADVERSARY_SIMS)})",
             file=sys.stderr,
         )
-    if a.telemetry_dir or a.trace or a.trace_jax:
+    if (a.telemetry_dir or a.trace or a.trace_jax
+            or cfg.fed.profile_rounds or a.metrics_interval):
         from fedml_tpu.core import telemetry
 
         telemetry.configure(
@@ -603,6 +632,7 @@ def main(argv=None) -> int:
             or telemetry.default_dir(cfg.out_dir, cfg.run_name),
             rank=0,
             jax_profiler=a.trace_jax,
+            metrics_interval=a.metrics_interval,
         )
     summaries = Experiment(cfg, a.repetitions).run()
     for s in summaries:

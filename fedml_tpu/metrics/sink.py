@@ -59,12 +59,32 @@ class MetricsSink:
     def close(self) -> None:
         if self.path:
             # the wandb-summary artifact (latest value per key), written
-            # next to the JSONL so CI can read one small file
+            # next to the JSONL so CI can read one small file. When the
+            # telemetry registry is live, its histograms ride along
+            # with their p50/p95/p99 (bucket-interpolated — see
+            # telemetry.percentiles_from_histogram for the error
+            # bound), so a run summary carries the round-latency SLO
+            # percentiles without a separate artifact.
+            summary = dict(self.summary)
+            try:
+                from fedml_tpu.core import telemetry
+
+                if telemetry.METRICS.enabled:
+                    hists = telemetry.METRICS.snapshot()["histograms"]
+                    if hists:
+                        keep = ("count", "sum", "min", "max",
+                                "p50", "p95", "p99")
+                        summary["telemetry_histograms"] = {
+                            name: {k: h[k] for k in keep if k in h}
+                            for name, h in hists.items()
+                        }
+            except Exception:
+                pass  # the summary must never die on telemetry state
             spath = os.path.join(
                 os.path.dirname(self.path) or ".", "summary.json"
             )
             with open(spath, "w") as f:
-                json.dump(self.summary, f, indent=2,
+                json.dump(summary, f, indent=2,
                           default=_json_default)
         if self._fh:
             self._fh.close()

@@ -58,7 +58,16 @@ from fedml_tpu.models.base import FedModel
 
 
 class ShardedFedAvg(FedAvgSim):
-    """FedAvg with the round compiled over a (clients, data) mesh."""
+    """FedAvg with the round compiled over a (clients, data) mesh.
+
+    Performance observability (core/perf.py) rides the inherited
+    :meth:`FedAvgSim.run` loop: with ``cfg.fed.profile_rounds > 0`` the
+    sharded round gets the same jax-profiler capture windows —
+    collectives (the client-axis ``psum``/``all_gather``) show up as
+    the breakdown's ``collective`` share — and the live ``perf.mfu``
+    gauge, whose peak-FLOPs denominator is the WHOLE mesh
+    (``peak_per_chip x mesh.devices.size``, resolved by
+    ``perf.build_sim_perf`` from :attr:`mesh`), not one chip."""
 
     def __init__(
         self,

@@ -15,10 +15,23 @@ Usage::
 
     python scripts/merge_trace.py RUN_TELEMETRY_DIR [--out merged.json]
     python scripts/merge_trace.py trace_rank0.json trace_rank1.json ...
+    python scripts/merge_trace.py RUN_TELEMETRY_DIR --jax-profile
 
 Timestamps are wall-clock (epoch) microseconds rebased to the earliest
 event, so ranks on the same host line up; ``X`` complete events carry
 span durations, instant events render as markers.
+
+jax-profiler captures (``--profile_rounds``, core/perf.py) live in
+their own files by design — ``<telemetry_dir>/jax_profile/round<k>/``,
+one session per profiled round — so they can never clobber the host
+span dumps, and ``--trace_jax`` annotations land INSIDE the capture
+they belong to. ``--jax-profile`` optionally folds those captures into
+the merged timeline: each profiled round becomes its own Perfetto
+process (``jax profile round <k>``) holding the XLA op events, rebased
+onto the host timeline via the epoch anchor in each capture's
+``capture.json`` manifest (written at ``start_trace`` time — alignment
+is anchor-accurate to ~ms, good enough to see which host span a device
+burst belongs to; within-capture relative timing is exact).
 """
 
 from __future__ import annotations
@@ -76,7 +89,8 @@ def merge(paths: list[str]) -> dict:
             print(f"warning: skipping unreadable dump {p!r}: {e}",
                   file=sys.stderr)
     if not events:
-        return {"traceEvents": [], "displayTimeUnit": "ms"}
+        return {"traceEvents": [], "displayTimeUnit": "ms",
+                "_epoch0": None}
     ts0 = min(float(ev.get("ts", 0.0)) for ev in events)
 
     trace_events: list[dict] = []
@@ -140,7 +154,86 @@ def merge(paths: list[str]) -> dict:
             "args": {"sort_index": r},
         })
 
-    return {"traceEvents": trace_events, "displayTimeUnit": "ms"}
+    # _epoch0 (the epoch-seconds base every ts was rebased against) is
+    # internal plumbing for fold_jax_profiles; stripped before writing
+    return {"traceEvents": trace_events, "displayTimeUnit": "ms",
+            "_epoch0": ts0}
+
+
+#: pid block for folded jax-profile rounds (far above any real rank)
+_JAX_PID_BASE = 9000
+
+
+def fold_jax_profiles(merged: dict, dirs: list[str]) -> int:
+    """Fold ``jax_profile/round<k>/`` captures (core/perf.py
+    RoundProfiler) into an already-merged Chrome trace, one synthetic
+    process per profiled round. Only XLA op events (those carrying an
+    ``hlo_op`` arg or living on a ``/device:*`` plane) are folded — the
+    captures also hold thousands of threadpool bookkeeping events that
+    would bury the timeline. Returns the number of folded events."""
+    try:
+        from fedml_tpu.core.perf import load_trace_events
+    except ImportError:
+        sys.path.insert(0, os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        from fedml_tpu.core.perf import load_trace_events
+
+    evs = merged["traceEvents"]
+    host_ts0_us = min(
+        (e["ts"] for e in evs if e.get("ph") in ("X", "i")),
+        default=None,
+    )
+    # the host events were rebased to their earliest epoch; recover the
+    # epoch base from the merge (merge() rebased by ts0 — stash it)
+    epoch0 = merged.get("_epoch0")
+    folded = 0
+    for d in dirs:
+        for rdir in sorted(glob.glob(os.path.join(d, "jax_profile",
+                                                  "round*"))):
+            manifest_path = os.path.join(rdir, "capture.json")
+            try:
+                with open(manifest_path) as f:
+                    manifest = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                print(f"warning: no capture manifest in {rdir!r}; "
+                      "skipping", file=sys.stderr)
+                continue
+            rnd = manifest.get("round", 0)
+            pid = _JAX_PID_BASE + int(rnd)
+            # rebase: event ts is session-relative; the manifest's
+            # t_start anchors the session on the epoch timeline
+            if epoch0 is not None:
+                base_us = (manifest["t_start"] - epoch0) * 1e6
+            else:
+                base_us = host_ts0_us or 0.0
+            n = 0
+            for ev in load_trace_events(rdir):
+                if ("hlo_op" not in ev["args"]
+                        and not ev["process"].startswith("/device:")):
+                    continue
+                evs.append({
+                    "name": ev["name"],
+                    "cat": "jax_op",
+                    "ph": "X",
+                    "pid": pid,
+                    "tid": ev["tid"],
+                    "ts": base_us + ev["ts"],
+                    "dur": ev["dur"],
+                    "args": ev["args"],
+                })
+                n += 1
+            if n:
+                evs.append({
+                    "ph": "M", "name": "process_name", "pid": pid,
+                    "tid": 0,
+                    "args": {"name": f"jax profile round {rnd}"},
+                })
+                evs.append({
+                    "ph": "M", "name": "process_sort_index", "pid": pid,
+                    "tid": 0, "args": {"sort_index": pid},
+                })
+            folded += n
+    return folded
 
 
 def resolve_inputs(inputs: list[str]) -> list[str]:
@@ -166,9 +259,19 @@ def main(argv=None) -> int:
     p.add_argument("--out", default=None,
                    help="output path (default: merged_trace.json next to "
                         "the first input)")
+    p.add_argument("--jax-profile", action="store_true",
+                   help="also fold jax-profiler captures "
+                        "(<dir>/jax_profile/round*/ from "
+                        "--profile_rounds) into the timeline, one "
+                        "Perfetto process per profiled round")
     a = p.parse_args(argv)
     paths = resolve_inputs(a.inputs)
     merged = merge(paths)
+    if a.jax_profile:
+        dirs = [d for d in a.inputs if os.path.isdir(d)]
+        n_jax = fold_jax_profiles(merged, dirs)
+        print(f"folded {n_jax} jax-profile op events", file=sys.stderr)
+    merged.pop("_epoch0", None)
     out = a.out
     if out is None:
         anchor = a.inputs[0]
