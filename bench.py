@@ -1187,6 +1187,214 @@ def defense_overhead_records(cohorts=(10, 50), iters=10):
     return records
 
 
+def wire_bench_records(cohort=10, topk_frac=0.01):
+    """Per-round wire bytes of the 100c CIFAR-10 ResNet-56 shape,
+    dense vs each delta codec — measured from the per-message-type
+    byte counters (``transport.bytes_by_type.*``) over a real
+    loopback transport pair, so the number is the encoded frame the
+    wire actually carries (seal + envelope + tensor-frame included),
+    not an analytic estimate. One round = ``cohort`` dense sync
+    broadcasts + ``cohort`` (possibly compressed) result payloads;
+    the codec shrinks ONLY the result class, which the per-type
+    counters keep attributable (docs/PERFORMANCE.md "Wire
+    compression").
+
+    ONE record per codec (the headline dense metric plus a
+    ``..._<codec>`` line per codec whose ``value`` is that codec's
+    DELTA-payload MB) — bench_diff compares only ``value``, so a
+    codec byte regression must move a tracked value, not a
+    side-field."""
+    import jax
+    import jax.numpy as jnp
+
+    from fedml_tpu.config import ModelConfig
+    from fedml_tpu.core import compress as CMP
+    from fedml_tpu.core import telemetry
+    from fedml_tpu.core.message import (
+        KEY_COMPRESSED,
+        KEY_MODEL_PARAMS,
+        KEY_NUM_SAMPLES,
+        KEY_ROUND,
+        MSG_TYPE_C2S_RESULT,
+        MSG_TYPE_S2C_SYNC_MODEL,
+        Message,
+    )
+    from fedml_tpu.core.transport.loopback import LoopbackHub
+    from fedml_tpu.models import create_model
+
+    model = create_model(ModelConfig(
+        name="resnet56", num_classes=10, input_shape=(32, 32, 3)
+    ))
+    variables = model.init(jax.random.key(0))
+    host_vars = jax.tree.map(np.asarray, variables)
+    key = jax.random.key(1)
+    delta = jax.tree.map(
+        lambda g: 0.01 * jax.random.normal(
+            jax.random.fold_in(key, g.size), g.shape, jnp.float32
+        ).astype(g.dtype),
+        variables,
+    )
+    trained = jax.tree.map(lambda g, d: g + d, variables, delta)
+
+    def round_bytes(method):
+        spec = CMP.CompressionSpec(
+            method=method, topk_frac=topk_frac, stochastic=False
+        )
+        hub = LoopbackHub()
+        sender, receiver = hub.create(1), hub.create(0)
+        hub.create(2)  # sync target
+        was = telemetry.METRICS.enabled
+        telemetry.METRICS.enabled = True
+        telemetry.METRICS.reset()
+        try:
+            for i in range(cohort):
+                receiver.send_message(Message(
+                    MSG_TYPE_S2C_SYNC_MODEL, 0, 2,
+                    {KEY_MODEL_PARAMS: host_vars, KEY_ROUND: 0},
+                ))
+                if spec.enabled():
+                    payload = jax.tree.map(np.asarray, CMP.compress_tree(
+                        spec, delta, jax.random.fold_in(key, i)
+                    ))
+                    body = {KEY_COMPRESSED: {
+                        "codec": method, "payload": payload,
+                    }}
+                else:
+                    body = {KEY_MODEL_PARAMS: jax.tree.map(
+                        np.asarray, trained
+                    )}
+                sender.send_message(Message(
+                    MSG_TYPE_C2S_RESULT, 1, 0,
+                    {**body, KEY_NUM_SAMPLES: 32.0, KEY_ROUND: 0},
+                ))
+            c = telemetry.METRICS.snapshot()["counters"]
+        finally:
+            telemetry.METRICS.enabled = was
+            telemetry.METRICS.reset()
+        # the loopback pair shares one process-global registry, so
+        # each frame is counted at BOTH its send and receive edge —
+        # halve for the on-the-wire byte count (a deploy rank only
+        # ever observes its own edge)
+        return (c["transport.bytes_by_type.c2s_result"] // 2,
+                c["transport.bytes_by_type.s2c_sync_model"] // 2)
+
+    base = "fedavg_wire_mb_per_round_100c_cifar10_resnet56"
+    per_codec, reductions, records = {}, {}, []
+    dense_result = dense_sync = None
+    for method in ("none", "int8", "topk", "topk_int8"):
+        result_b, sync_b = round_bytes(method)
+        if method == "none":
+            dense_result, dense_sync = result_b, sync_b
+        per_codec[method] = {
+            "result_mb": round(result_b / 1e6, 4),
+            "round_total_mb": round((result_b + sync_b) / 1e6, 4),
+        }
+        reductions[method] = round(dense_result / result_b, 2)
+        if method != "none":
+            records.append({
+                "metric": f"{base}_{method}",
+                "value": round(result_b / 1e6, 4),
+                "unit": "MB/round",
+                "vs_baseline": round(dense_result / result_b, 2),
+                "cohort": cohort,
+                "topk_frac": topk_frac,
+                "delta_payload_reduction_vs_dense":
+                    reductions[method],
+            })
+    records.insert(0, {
+        "metric": base,
+        "value": per_codec["none"]["round_total_mb"],
+        "unit": "MB/round",
+        "vs_baseline": None,
+        "cohort": cohort,
+        "topk_frac": topk_frac,
+        "per_codec_mb": per_codec,
+        "delta_payload_reduction_vs_dense": reductions,
+        "sync_mb": round(dense_sync / 1e6, 4),
+    })
+    return records
+
+
+def defense_sharded_records(mesh_sizes=(1, 4, 8), c=1000, iters=3):
+    """Defense-enabled server update at C=1000 over the client-sharded
+    mesh (parallel/sharded_agg.py): per-rule aggregation time at each
+    mesh size that fits the available devices — the evidence that the
+    sharded path's aggregation time scales with mesh size (ROADMAP
+    item 2 acceptance). Same ResNet-56-sized stack and overhead-vs-
+    mean accounting as ``defense_overhead_records``; mesh sizes beyond
+    the device count are skipped with a note (a 1-chip host still
+    records the m=1 baseline)."""
+    import jax
+    import jax.numpy as jnp
+
+    from fedml_tpu.config import ExperimentConfig, FedConfig
+    from fedml_tpu.algorithms.fedavg import (
+        ServerState, make_server_optimizer,
+    )
+    from fedml_tpu.core import tree as T
+    from fedml_tpu.parallel import ShardedAggregator, make_client_mesh
+
+    key = jax.random.key(0)
+    params = {
+        "w": jax.random.normal(key, (860, 1000), jnp.float32),
+        "b": jax.random.normal(key, (1210,), jnp.float32),
+    }
+    stacked = {"params": {
+        "w": jax.random.normal(key, (c, 860, 1000), jnp.float32),
+        "b": jax.random.normal(key, (c, 1210), jnp.float32),
+    }}
+    weights = jnp.ones((c,))
+    opt = make_server_optimizer("sgd", 1.0, 0.0)
+    rules = ("mean", "median", "trimmed_mean", "krum", "multikrum",
+             "fltrust")
+    records = []
+    n_dev = len(jax.devices())
+    for m in mesh_sizes:
+        if m > n_dev:
+            print(f"[bench] defense m-sweep: mesh {m} > {n_dev} "
+                  "available devices; skipped", file=sys.stderr,
+                  flush=True)
+            continue
+        mesh = make_client_mesh(m)
+        ms = {}
+        for rule in rules:
+            fed = FedConfig(
+                robust_method=rule,
+                robust_num_adversaries=(c // 5 if "krum" in rule
+                                        else 0),
+            )
+            agg = ShardedAggregator(ExperimentConfig(fed=fed), 1, 32,
+                                    mesh=mesh)
+            state = ServerState(
+                variables={"params": params},
+                opt_state=opt.init(params),
+                momentum=T.tree_zeros_like(params),
+                round=jnp.asarray(0, jnp.int32),
+            )
+            rkey = jax.random.key(3)
+            state = agg.update(state, stacked, weights, rkey)  # compile
+            jax.block_until_ready(jax.tree.leaves(state.variables))
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                state = agg.update(state, stacked, weights, rkey)
+            jax.block_until_ready(jax.tree.leaves(state.variables))
+            ms[rule] = (time.perf_counter() - t0) / iters * 1e3
+        overhead = {k: ms[k] - ms["mean"] for k in ms if k != "mean"}
+        records.append({
+            "metric": f"defense_agg_overhead_ms_c{c}_m{m}",
+            "value": max(overhead.values()),
+            "unit": "ms/round",
+            "cohort": c,
+            "mesh": m,
+            "params": int(sum(v.size for v in params.values())),
+            "agg_ms": {k: round(v, 4) for k, v in ms.items()},
+            "overhead_vs_mean_ms": {
+                k: round(v, 4) for k, v in overhead.items()
+            },
+        })
+    return records
+
+
 def elastic_churn_record(rounds=24, num_clients=32, cohort=16, seed=0):
     """Compile-cache hit rate under a seeded membership-churn schedule
     (docs/FAULT_TOLERANCE.md "Elastic membership"): an elastic
@@ -1386,6 +1594,12 @@ def main():
                          "rate under a seeded membership-churn "
                          "schedule (one compile per bucket vs one per "
                          "distinct cohort size)")
+    ap.add_argument("--wire-bench", action="store_true",
+                    help="ONLY the wire-compression stage: per-round "
+                         "wire MB of the 100c ResNet-56 shape, dense "
+                         "vs each delta codec, measured from the "
+                         "transport.bytes_by_type counters over a "
+                         "real loopback pair")
     args = ap.parse_args()
 
     # Fail FAST if the device backend cannot come up: a wedged TPU
@@ -1487,9 +1701,18 @@ def main():
     if args.defense_bench:
         for rec in staged("defense", defense_overhead_records):
             emit(rec)
+        # the mesh-size sweep for the client-sharded aggregation path
+        # (parallel/sharded_agg.py): does aggregation time scale with
+        # the mesh? A 1-chip host records the m=1 baseline only.
+        for rec in staged("defense_sharded", defense_sharded_records):
+            emit(rec)
         return
     if args.elastic_bench:
         emit(staged("elastic", elastic_churn_record))
+        return
+    if args.wire_bench:
+        for rec in staged("wire", wire_bench_records):
+            emit(rec)
         return
     if args.synthetic_acc:
         rec = staged("synthetic_acc", synthetic_leaf_acc_record)
@@ -1592,6 +1815,24 @@ def main():
     except Exception as err:
         print(f"[bench] defense stage failed: {err}", file=sys.stderr,
               flush=True)
+    try:
+        # wire compression: per-round MB dense vs each codec (one
+        # tracked record per codec), from the per-type byte counters
+        # (docs/PERFORMANCE.md "Wire compression") — bench_diff tracks
+        # them from this round on
+        for rec in staged("wire", wire_bench_records):
+            emit(rec)
+    except Exception as err:
+        print(f"[bench] wire stage failed: {err}", file=sys.stderr,
+              flush=True)
+    try:
+        # sharded-aggregation mesh sweep at C=1000 (m=1 baseline on a
+        # 1-chip host; larger meshes recorded where devices exist)
+        for rec in staged("defense_sharded", defense_sharded_records):
+            emit(rec)
+    except Exception as err:
+        print(f"[bench] defense m-sweep failed: {err}",
+              file=sys.stderr, flush=True)
     sim, _ = build_sim(model_name="resnet56")
     emit(staged(
         "rate.resnet56_std",

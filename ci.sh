@@ -110,6 +110,14 @@ echo "== elastic smoke: mid-run admission + graceful LEAVE =="
 # (docs/FAULT_TOLERANCE.md "Elastic membership")
 JAX_PLATFORMS=cpu python scripts/elastic_smoke.py "$OUT/elastic"
 
+echo "== compress smoke: topk_int8 wire vs dense over gRPC =="
+# the same 1-server + 2-client gRPC world runs dense and under
+# --compress topk_int8: the per-type byte counters must show >=4x on
+# the c2s_result delta payloads specifically (syncs stay dense), zero
+# decode errors, and a converged run (docs/PERFORMANCE.md "Wire
+# compression")
+JAX_PLATFORMS=cpu python scripts/compress_smoke.py "$OUT/compress"
+
 echo "== perf smoke: --profile_rounds device-time breakdown + perf.* gauges =="
 # a tiny CPU sim with --profile_rounds 2 must leave (a) a per-round
 # device-time breakdown artifact whose captures actually contained XLA

@@ -25,6 +25,7 @@ import numpy as np
 
 from fedml_tpu.config import ExperimentConfig
 from fedml_tpu.core import adversary as A
+from fedml_tpu.core import compress as CMP
 from fedml_tpu.core import elastic as E
 from fedml_tpu.core import robust, telemetry
 from fedml_tpu.core import tree as T
@@ -33,6 +34,7 @@ from fedml_tpu.core.reputation import QuarantinePolicy, ReputationTracker
 from fedml_tpu.core.manager import ClientManager, ServerManager
 from fedml_tpu.core.message import (
     KEY_CLIENT_INDEX,
+    KEY_COMPRESSED,
     KEY_MODEL_PARAMS,
     KEY_NUM_SAMPLES,
     KEY_ROUND,
@@ -289,6 +291,14 @@ class FedAvgServerActor(ServerManager):
         # an XLA recompile. Off by default: the eager aggregation path
         # below stays byte-identical to its pre-elastic self.
         self._elastic = bool(cfg.fed.elastic_buckets)
+        # NOTE deliberately NOT donated: on the CPU backend
+        # ``np.asarray`` of a jax array is zero-copy, so the
+        # ``_round_sync`` host snapshot a mid-round WELCOME replays can
+        # ALIAS the live ServerState buffers — donating the state would
+        # let the compiled update overwrite the snapshot under a
+        # concurrent rejoin (the same aliasing class PR 1's checkpoint
+        # zero-copy SIGSEGV fix documents). The sim round donates
+        # instead, where the state has exactly one owner.
         self._agg_cache = (
             E.CompiledRoundCache(self._bucketed_update)
             if self._elastic else None
@@ -297,6 +307,42 @@ class FedAvgServerActor(ServerManager):
             E.CompiledRoundCache(self._bucketed_diag)
             if self._elastic else None
         )
+        # -- compressed weight-update wire (core/compress.py,
+        # docs/PERFORMANCE.md "Wire compression"): clients ship typed
+        # quantized/sparsified delta payloads instead of dense
+        # variables; the server validates them at the receive edge,
+        # stores the (small) payloads, and decompresses the stacked
+        # round inside a compiled — optionally client-axis-sharded —
+        # program at close. Off by default: the dense path is
+        # byte-identical on the wire and in here.
+        self._cspec = CMP.CompressionSpec.from_fed(cfg.fed,
+                                                   seed=cfg.seed)
+        self._payload_template = (
+            CMP.payload_template(self._cspec, self.state.variables)
+            if self._cspec.enabled() else None
+        )
+        if self._cspec.enabled():
+            telemetry.METRICS.gauge(
+                "compress.ratio",
+                CMP.wire_ratio(self._cspec, self.state.variables),
+            )
+        self._decomp_cache = (
+            E.CompiledRoundCache(self._decompress_prog)
+            if self._cspec.enabled() else None
+        )
+        # -- mesh-sharded server update (parallel/sharded_agg.py,
+        # ROADMAP item 2): shard decompress -> clip -> defense-reduce
+        # -> optimizer step over the client axis of a mesh spanning
+        # this host's devices, all-gathering only the final params.
+        # Off by default: the replicated paths above stay untouched.
+        self._sharded = None
+        if cfg.fed.shard_aggregation:
+            from fedml_tpu.parallel.sharded_agg import ShardedAggregator
+
+            self._sharded = ShardedAggregator(
+                cfg, self.steps_per_epoch, self.batch_size,
+                spec=self._cspec,
+            )
         if checkpointer is not None:
             if checkpoint_every < 1:
                 raise ValueError(
@@ -859,27 +905,84 @@ class FedAvgServerActor(ServerManager):
             return True
         return False
 
+    def _screen_compressed(self, msg: Message):
+        """Receive-edge screen for a compressed result: the typed
+        payload must match the spec's expected structure (codec tag,
+        per-leaf shapes/dtypes, in-range top-k indices) and carry only
+        finite floats — a malformed or poisoned payload is counted
+        ``compress.decode_errors`` and dropped, never stacked into the
+        compiled decompress. Returns the payload or None."""
+        comp = msg.get(KEY_COMPRESSED)
+        err = None
+        if not isinstance(comp, dict) or "payload" not in comp:
+            err = (
+                "dense result on a compressed wire"
+                if msg.get(KEY_MODEL_PARAMS) is not None
+                else "missing compressed payload"
+            )
+        elif comp.get("codec") != self._cspec.method:
+            err = (
+                f"codec {comp.get('codec')!r} != configured "
+                f"{self._cspec.method!r}"
+            )
+        else:
+            err = CMP.validate_payload(self._payload_template,
+                                       comp["payload"])
+        if err is not None:
+            telemetry.METRICS.inc("compress.decode_errors")
+            telemetry.RECORDER.record(
+                "compress_decode_error", peer=msg.sender,
+                round=msg.get(KEY_ROUND), detail=err,
+            )
+            return None
+        return comp["payload"]
+
     def _handle_result(self, msg: Message) -> None:
         # cheap checks FIRST: a duplicate or post-close straggler must
         # not pay the full-pytree scan below
         with self._lock:
             if self._discard_locked(msg):
                 return
-        params = msg.get(KEY_MODEL_PARAMS)
         n_k = float(msg.get(KEY_NUM_SAMPLES))
-        # non-finite screening (outside the lock — it touches every
-        # leaf): a single NaN/Inf delta defeats the weighted mean AND
-        # norm-clip (NaN * 0-scale is still NaN), so a poisoned result
-        # never enters the aggregate. The screened rank stays live and
-        # simply has no result this round — it counts against quorum
-        # like a straggler.
-        if not _result_is_finite(params, n_k):
-            telemetry.METRICS.inc("robust.nonfinite_rejected")
-            telemetry.RECORDER.record(
-                "nonfinite_rejected", peer=msg.sender,
-                round=msg.get(KEY_ROUND),
-            )
-            return
+        if self._cspec.enabled():
+            params = self._screen_compressed(msg)
+            if params is None:
+                return
+            if not math.isfinite(n_k):
+                # mirror the dense screen's accounting: a poisoned
+                # sample count must be as visible on the compressed
+                # wire as on the dense one
+                telemetry.METRICS.inc("robust.nonfinite_rejected")
+                telemetry.RECORDER.record(
+                    "nonfinite_rejected", peer=msg.sender,
+                    round=msg.get(KEY_ROUND),
+                )
+                return
+        else:
+            params = msg.get(KEY_MODEL_PARAMS)
+            if params is None:
+                # a compressed result against a dense-configured
+                # server (config skew between ranks): unusable
+                telemetry.METRICS.inc("compress.decode_errors")
+                telemetry.RECORDER.record(
+                    "compress_decode_error", peer=msg.sender,
+                    round=msg.get(KEY_ROUND),
+                    detail="compressed result on a dense wire",
+                )
+                return
+            # non-finite screening (outside the lock — it touches
+            # every leaf): a single NaN/Inf delta defeats the weighted
+            # mean AND norm-clip (NaN * 0-scale is still NaN), so a
+            # poisoned result never enters the aggregate. The screened
+            # rank stays live and simply has no result this round — it
+            # counts against quorum like a straggler.
+            if not _result_is_finite(params, n_k):
+                telemetry.METRICS.inc("robust.nonfinite_rejected")
+                telemetry.RECORDER.record(
+                    "nonfinite_rejected", peer=msg.sender,
+                    round=msg.get(KEY_ROUND),
+                )
+                return
         with self._lock:
             # re-validate: the round can close, or the sender can die
             # or deliver via another path, while the scan ran unlocked
@@ -914,6 +1017,42 @@ class FedAvgServerActor(ServerManager):
             local_reducer(),
             valid=valid,
         )
+
+    def _decompress_prog(self, stacked_payload, gvars):
+        """Bucket-compiled decompress: stacked payloads -> stacked
+        dense VARIABLES (``global + delta``). A padded zero payload
+        row decompresses to a delta of exactly zero — the healed-row
+        convention every downstream mask-aware rule expects."""
+        delta = CMP.decompress_stacked(self._cspec, stacked_payload,
+                                       gvars)
+        return jax.tree.map(
+            lambda g, d: (g[None] + d).astype(g.dtype), gvars, delta
+        )
+
+    def _decompress_results(
+        self, results: dict[int, tuple[dict, float]]
+    ) -> dict:
+        """Inflate one closed round's compressed payloads into dense
+        variables through ONE compiled decompress over the stacked
+        round — client-axis-sharded when the mesh is on, bucket-padded
+        so membership churn stays a compile-cache hit. Returns the
+        dense stacked tree in sorted-rank order; downstream
+        (reputation scoring, aggregation) consumes the STACK directly
+        — rows are sliced out only on the rare quarantine-exclusion
+        path."""
+        ranks = sorted(results)
+        stacked = T.tree_stack([
+            jax.tree.map(jnp.asarray, results[r][0]) for r in ranks
+        ])
+        n = len(ranks)
+        if self._sharded is not None:
+            return self._sharded.decompress(stacked,
+                                            self.state.variables, n)
+        bucket = E.bucket_for(n) if self._elastic else n
+        padded = CMP.pad_stacked_payload(stacked, bucket)
+        dense = self._decomp_cache(bucket, padded,
+                                   self.state.variables)
+        return jax.tree.map(lambda x: x[:n], dense)
 
     @staticmethod
     def _bucketed_diag(stacked_params, gp, valid):
@@ -953,7 +1092,8 @@ class FedAvgServerActor(ServerManager):
         return {k: np.asarray(v) for k, v in out.items()}
 
     def _score_and_exclude(
-        self, results: dict[int, tuple[dict, float]], closed_idx: int
+        self, results: dict[int, tuple[dict, float]], closed_idx: int,
+        stacked_all: dict | None = None,
     ) -> tuple[list[int], dict | None]:
         """The reputation pass over one closed round's results: score
         every reporter, fold into the cross-round tracker, and return
@@ -971,9 +1111,13 @@ class FedAvgServerActor(ServerManager):
             self._pipeline.method != "mean" and m.enabled
         )
         if not score_now or not ranks:
-            return ranks, None
+            # the caller may already hold the stacked round (the
+            # compressed path's decompress output) — pass it back so
+            # it is never rebuilt from rows
+            return ranks, stacked_all
         self._reputation.ensure_size(max(ranks) + 1)
-        stacked_all = T.tree_stack([results[r][0] for r in ranks])
+        if stacked_all is None:
+            stacked_all = T.tree_stack([results[r][0] for r in ranks])
         diag = self._diagnose(stacked_all, len(ranks))
         events = self._reputation.observe(closed_idx, ranks,
                                           diag["score"])
@@ -1080,12 +1224,42 @@ class FedAvgServerActor(ServerManager):
             dead_peers=dead if dead is not None else [],
         )
         t_agg0 = time.monotonic()
-        included, stacked = self._score_and_exclude(results, closed_idx)
+        stacked_all = None
+        if self._cspec.enabled() and results:
+            # inflate the round's compressed payloads first (ONE
+            # compiled decompress over the stacked round; sharded over
+            # the client axis when the mesh is on) — scoring and every
+            # aggregation path below consume the dense STACK directly,
+            # built exactly once; results keep the (small) payloads
+            stacked_all = self._decompress_results(results)
+        included, stacked = self._score_and_exclude(
+            results, closed_idx, stacked_all
+        )
         if stacked is None:
-            stacked = T.tree_stack([results[r][0] for r in included])
+            if stacked_all is not None:
+                # quarantine dropped ranks from a compressed round:
+                # gather the kept rows out of the decompressed stack
+                # (results still hold payloads, not dense rows)
+                ranks = sorted(results)
+                keep = jnp.asarray(
+                    [ranks.index(r) for r in included], jnp.int32
+                )
+                stacked = jax.tree.map(lambda x: x[keep], stacked_all)
+            else:
+                stacked = T.tree_stack(
+                    [results[r][0] for r in included]
+                )
         weights = jnp.asarray([results[r][1] for r in included])
         rkey = RND.round_key(self.root_key, self.state.round)
-        if self._elastic:
+        if self._sharded is not None:
+            # mesh-sharded update (parallel/sharded_agg.py): pads the
+            # cohort to the mesh bucket itself and returns the new
+            # replicated state — elastic or not, churn costs a
+            # compile-cache hit in ITS executable LRU
+            self.state = self._sharded.update(
+                self.state, stacked, weights, rkey
+            )
+        elif self._elastic:
             # shape-bucketed aggregation (core/elastic.py): pad the
             # cohort to its power-of-two bucket and run the
             # bucket-compiled executable — a cohort-size change between
@@ -1218,6 +1392,29 @@ class FedAvgClientActor(ClientManager):
             if adv.enabled() and adv.is_member(rank, size - 1, base=1)
             else None
         )
+        # -- compressed weight-update wire (core/compress.py): this
+        # rank deltas its trained variables against the round's sync,
+        # folds in the error-feedback residual it carries across
+        # rounds, and ships the typed quantized/sparsified payload
+        # instead of dense variables. Off by default (dense wire,
+        # byte-identical).
+        self._cspec = CMP.CompressionSpec.from_fed(cfg.fed,
+                                                   seed=cfg.seed)
+        self._residual = None  # lazy zero carry, shaped like variables
+        self._compress_fn = None
+        self._comp_cache: tuple[int, dict] | None = None
+        if self._cspec.enabled():
+            spec = self._cspec
+
+            def _compress(delta, residual, key):
+                payload, _, new_res = CMP.apply_with_feedback(
+                    spec, delta, residual, key
+                )
+                return payload, new_res
+
+            # the carried residual is donated: new carry aliases old
+            self._compress_fn = jax.jit(_compress,
+                                        donate_argnums=(1,))
         self.register_message_receive_handler(
             MSG_TYPE_S2C_SYNC_MODEL, self._handle_sync
         )
@@ -1227,6 +1424,49 @@ class FedAvgClientActor(ClientManager):
         self.register_message_receive_handler(
             MSG_TYPE_S2C_WELCOME, self._handle_sync
         )
+
+    def _compress_result(self, synced_vars, new_vars,
+                         round_idx: int) -> dict:
+        """Delta, fold in the error-feedback carry, compress, and
+        advance the carry — ONCE per round: a duplicate sync for the
+        same round (WELCOME racing the broadcast, chaos dup) re-sends
+        the cached payload, and a delayed duplicate of an OLDER round
+        — whose result the server's round-tag check is guaranteed to
+        discard — is compressed against an empty carry WITHOUT
+        touching the live residual (re-consuming it would mark its
+        error as transmitted when the server never books it)."""
+        if (self._comp_cache is not None
+                and round_idx == self._comp_cache[0]):
+            return self._comp_cache[1]
+        key = CMP.slot_key(
+            self._cspec,
+            RND.round_key(self.root_key,
+                          jnp.asarray(round_idx, jnp.int32)),
+            self.rank - 1,
+        )
+        delta = jax.tree.map(jnp.subtract, new_vars, synced_vars)
+        if (self._comp_cache is not None
+                and round_idx < self._comp_cache[0]):
+            payload = CMP.compress_tree(self._cspec, delta, key)
+            return {
+                "codec": self._cspec.method,
+                "payload": jax.tree.map(np.asarray, payload),
+            }
+        if self._residual is None:
+            self._residual = jax.tree.map(jnp.zeros_like, synced_vars)
+        payload, self._residual = self._compress_fn(
+            delta, self._residual, key
+        )
+        m = telemetry.METRICS
+        if m.enabled:
+            m.gauge("compress.residual_norm",
+                    float(T.tree_l2_norm(self._residual)))
+        wire = {
+            "codec": self._cspec.method,
+            "payload": jax.tree.map(np.asarray, payload),
+        }
+        self._comp_cache = (round_idx, wire)
+        return wire
 
     def _handle_sync(self, msg: Message) -> None:
         client_idx = int(msg.get(KEY_CLIENT_INDEX))
@@ -1255,14 +1495,24 @@ class FedAvgClientActor(ClientManager):
                     self.rank,
                 )
                 telemetry.METRICS.inc("adversary.corrupted_results")
-            host_vars = jax.tree.map(np.asarray, new_vars)
+            if self._cspec.enabled():
+                result_payload = {
+                    KEY_COMPRESSED: self._compress_result(
+                        variables, new_vars, round_idx
+                    ),
+                }
+            else:
+                result_payload = {
+                    KEY_MODEL_PARAMS: jax.tree.map(np.asarray,
+                                                   new_vars),
+                }
         self.send_message(
             Message(
                 MSG_TYPE_C2S_RESULT,
                 self.rank,
                 0,
                 {
-                    KEY_MODEL_PARAMS: host_vars,
+                    **result_payload,
                     KEY_NUM_SAMPLES: float(n_k),
                     # round tag: lets the server discard a straggler's
                     # result that arrives after its round already closed

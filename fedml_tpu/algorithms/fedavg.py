@@ -31,6 +31,7 @@ import optax
 
 from fedml_tpu.config import ExperimentConfig, FedConfig, TrainConfig
 from fedml_tpu.core import adversary as A
+from fedml_tpu.core import compress as C
 from fedml_tpu.core import elastic as E
 from fedml_tpu.core import random as R
 from fedml_tpu.core import robust, telemetry, tree as T
@@ -61,6 +62,11 @@ def consume_round_counters(train_metrics: dict) -> dict:
             telemetry.METRICS.inc("robust.nonfinite_rejected", r)
             telemetry.RECORDER.record("nonfinite_rejected", count=r,
                                       path="sim")
+    res = train_metrics.pop("compress_residual_norm", None)
+    if res is not None:
+        # the error-feedback carry (docs/OBSERVABILITY.md): bounded ==
+        # compression error is telescoping carry, not accumulating bias
+        telemetry.METRICS.gauge("compress.residual_norm", float(res))
     return train_metrics
 
 
@@ -75,11 +81,15 @@ class Reducer(NamedTuple):
     """How to reduce per-client quantities over the (possibly sharded)
     cohort. ``wmean(stacked, w)``: weighted mean over ALL clients;
     ``sum_scalar``: global scalar sum; ``gather``: full stacked tree (for
-    coordinate-wise defenses)."""
+    coordinate-wise defenses); ``axis``: the mesh axis the cohort is
+    sharded over (None on a local reduce) — defense rules with a
+    blockwise-shardable term (the Krum gram) key their sharded fast
+    path off it."""
 
     wmean: Callable[[Pytree, jax.Array], Pytree]
     sum_scalar: Callable[[jax.Array], jax.Array]
     gather: Callable[[Pytree], Pytree]
+    axis: str | None = None
 
 
 def local_reducer() -> Reducer:
@@ -102,6 +112,7 @@ def psum_reducer(axis: str) -> Reducer:
         gather=lambda t: jax.tree.map(
             lambda v: jax.lax.all_gather(v, axis, tiled=True), t
         ),
+        axis=axis,
     )
 
 
@@ -319,7 +330,17 @@ class FedAvgSim:
         )
         self.evaluator = build_evaluator(model, self.task)
         self.root_key = jax.random.key(cfg.seed)
-        self._round_fn = jax.jit(self._round, donate_argnums=(0,))
+        # -- wire compression (core/compress.py, docs/PERFORMANCE.md
+        # "Wire compression"): with cfg.fed.compress the round applies
+        # the exact compress->decompress arithmetic the deploy wire
+        # sees — per-slot, inside the compiled round, with the
+        # error-feedback residual carried across rounds as a donated
+        # [bucket, ...] operand. Off by default: the dense round is
+        # byte-identical (no extra operand, no residual allocation).
+        self._cspec = C.CompressionSpec.from_fed(cfg.fed, seed=cfg.seed)
+        self._ef_residual = None  # lazy zero carry, [bucket, ...]
+        donate = (0, 3) if self._cspec.enabled() else (0,)
+        self._round_fn = jax.jit(self._round, donate_argnums=donate)
 
     def _prepare_data(self, data: FederatedData, cfg: ExperimentConfig):
         """Resolve device data + batch size. The mesh-sharded subclass
@@ -468,8 +489,38 @@ class FedAvgSim:
         rejected = (ok.shape[0] - jnp.sum(ok)).astype(jnp.float32)
         return cleaned, n_k, rejected
 
+    def _wire_roundtrip(self, state, stacked_vars, residual, rkey,
+                        live):
+        """The in-round wire model (core/compress.py): delta each
+        slot's variables against the global model, fold in the
+        error-feedback carry, compress->decompress with the SAME
+        arithmetic the deploy wire applies, and rebuild the variables
+        from the decompressed delta. Padded slots of an elastic bucket
+        get their carry zeroed (a slot that just left the live prefix
+        must not smuggle its stale residual into a healed row's
+        content)."""
+        gp = state.variables
+        deltas = jax.tree.map(
+            lambda s, g: s - g[None], stacked_vars, gp
+        )
+        deq, new_residual = C.roundtrip_stacked(
+            self._cspec, deltas, residual, rkey
+        )
+        stacked_vars = jax.tree.map(
+            lambda g, d: (g[None] + d).astype(d.dtype), gp, deq
+        )
+        if live is not None:
+            new_residual = jax.tree.map(
+                lambda r: jnp.where(
+                    live.reshape((-1,) + (1,) * (r.ndim - 1)),
+                    r, jnp.zeros((), r.dtype),
+                ),
+                new_residual,
+            )
+        return stacked_vars, new_residual
+
     def _round(self, state: ServerState, arrays: FederatedArrays,
-               n_active=None):
+               n_active=None, residual=None):
         cfg = self.cfg.fed
         stacked_vars, n_k, msums, rkey, cohort = self._locals(
             state, arrays, n_active
@@ -479,14 +530,24 @@ class FedAvgSim:
             stacked_vars = self._inject_adversaries(
                 state, arrays, stacked_vars, cohort
             )
-        live = None
-        if n_active is not None:
+        live = (
+            E.active_mask(self._bucket, n_active)
+            if n_active is not None else None
+        )
+        new_residual = None
+        if residual is not None:
+            # wire order mirrors the deploy path: the client compresses
+            # its (possibly adversarial) delta, THEN the server pads /
+            # screens what it decompressed
+            stacked_vars, new_residual = self._wire_roundtrip(
+                state, stacked_vars, residual, rkey, live
+            )
+        if live is not None:
             # elastic bucketing: the padded slots beyond the live
             # cohort are healed to the global model (delta exactly 0)
             # with zero weight BEFORE screening, so downstream they are
             # indistinguishable from absent — and they must not pollute
             # the round's train metrics either
-            live = E.active_mask(self._bucket, n_active)
             stacked_vars, n_k, msums = E.mask_padded(
                 stacked_vars, n_k, msums, state.variables, live
             )
@@ -516,22 +577,47 @@ class FedAvgSim:
             # robust.nonfinite_rejected counter)
             "nonfinite_rejected": rejected,
         }
+        if new_residual is not None:
+            train_metrics["compress_residual_norm"] = T.tree_l2_norm(
+                new_residual
+            )
+            return new_state, train_metrics, new_residual
         return new_state, train_metrics
 
     # -- public API --------------------------------------------------------
     def run_round(self, state: ServerState):
+        compressed = self._cspec.enabled()
+        if compressed and self._ef_residual is None:
+            self._ef_residual = C.zero_residual(
+                state.variables, self._bucket
+            )
+            telemetry.METRICS.gauge(
+                "compress.ratio",
+                C.wire_ratio(self._cspec, state.variables),
+            )
         if not self._elastic:
-            return self._round_fn(state, self.arrays)
+            if not compressed:
+                return self._round_fn(state, self.arrays)
+            state, m, self._ef_residual = self._round_fn(
+                state, self.arrays, None, self._ef_residual
+            )
+            return state, m
         # the live count rides as a TRACED operand: any cohort size in
         # [1, bucket] reuses the one compiled program; jit's own cache
         # is the executable store here
-        return E.mirror_jit_cache(
+        n = jnp.asarray(self._n_active, jnp.int32)
+        if not compressed:
+            return E.mirror_jit_cache(
+                self._round_fn,
+                lambda: self._round_fn(state, self.arrays, n),
+            )
+        state, m, self._ef_residual = E.mirror_jit_cache(
             self._round_fn,
             lambda: self._round_fn(
-                state, self.arrays,
-                jnp.asarray(self._n_active, jnp.int32),
+                state, self.arrays, n, self._ef_residual
             ),
         )
+        return state, m
 
     def evaluate_global(self, state: ServerState) -> dict:
         m = self.evaluator(

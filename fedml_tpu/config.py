@@ -131,6 +131,22 @@ class FedConfig:
     # default: the static path stays byte-identical to its
     # pre-elastic self.
     elastic_buckets: bool = False
+    # wire compression for the client->server weight update
+    # (core/compress.py, docs/PERFORMANCE.md "Wire compression"):
+    # "none" | "int8" | "topk" | "topk_int8". Compresses the RESULT
+    # delta payload with client-side error feedback; "none" (the
+    # default) leaves every path byte-identical to the dense codec.
+    compress: str = "none"
+    # fraction of each leaf's entries the topk family keeps (>= 1)
+    compress_topk_frac: float = 0.01
+    # mesh-sharded server aggregation (parallel/sharded_agg.py,
+    # docs/PERFORMANCE.md "Sharded server update"): the deploy server
+    # actor shards decompress -> clip -> defense-reduce -> optimizer
+    # step over the client axis of a mesh spanning its local devices,
+    # all-gathering only the final params. Off by default: the
+    # replicated aggregation path stays byte-identical. (The sims have
+    # their own sharded runtime, parallel/client_parallel.py.)
+    shard_aggregation: bool = False
     # performance observability (core/perf.py, docs/OBSERVABILITY.md
     # "Performance observability"): capture jax.profiler windows around
     # the first K compiled rounds and parse each into a device-time

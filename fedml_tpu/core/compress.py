@@ -1,0 +1,488 @@
+"""Delta compression for the client->server weight-update wire.
+
+Every client<->server exchange used to ship the model delta as a dense
+float pytree through the pickle/tensor-frame codec. This module shrinks
+the RESULT payload — the per-round ``C x model`` term that dominates
+wire bytes at scale (the sync broadcast stays dense: the server has no
+residual channel to a client, and a lossy global model would corrupt
+every client's starting point):
+
+- **int8 quantization** (``int8``): per-leaf absmax scale, values
+  rounded to [-127, 127] — 4x fewer bytes than f32, optionally with
+  seeded *stochastic* rounding so the quantizer is unbiased
+  (``E[Q(x)] = x``), the standard pairing with error feedback.
+- **top-k sparsification** (``topk``): per-leaf, keep the ``k =
+  max(1, topk_frac * size)`` largest-magnitude entries as (int32 index,
+  f32 value) pairs — ~``8/4 * topk_frac`` of the dense bytes.
+- **both** (``topk_int8``): sparsify, then int8-quantize the survivors
+  — ~``5/4 * topk_frac`` of dense (the ratio the >=4x acceptance bar
+  rides on at the default ``topk_frac``).
+
+**Error feedback** (Seide et al. 2014 / Karimireddy et al. 2019): the
+client carries the compression residual ``r_t = (d_t + r_{t-1}) -
+deQ(Q(d_t + r_{t-1}))`` across rounds and folds it into the next delta
+before compressing. The transmitted sequence then telescopes —
+``sum_t transmitted_t = sum_t d_t - r_T`` exactly — so compression
+error is bounded carry, not accumulating bias (pinned in
+``tests/test_compress.py``).
+
+The codec is pure jax end to end, so the SAME arithmetic runs in three
+places without drift:
+
+- the deploy client compresses its delta before the send and the
+  server decompresses the stacked payloads inside a compiled (and
+  optionally mesh-sharded) program;
+- the in-process sim round applies ``roundtrip_stacked`` — compress
+  then decompress, fused by XLA — inside the jitted round, so the sim
+  measures the exact arithmetic the wire would see;
+- padded rows of an elastic bucket (:mod:`fedml_tpu.core.elastic`)
+  are all-zero payloads that decompress to a delta of exactly zero —
+  compression composes with bucket padding by construction.
+
+``method="none"`` is the default and leaves every path byte-identical
+to the dense codec: no payload key is added, no jit operand changes,
+no residual is allocated.
+
+Telemetry (docs/OBSERVABILITY.md): ``compress.ratio`` (dense/wire
+bytes, analytic), ``compress.residual_norm`` (client-side carry),
+``compress.decode_errors`` (malformed/mismatched payloads the server
+dropped).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Pytree = Any
+
+METHODS = ("none", "int8", "topk", "topk_int8")
+
+#: fold_in salt separating the quantizer's rng stream from every other
+#: consumer of the round key
+_KEY_SALT = 0x43505253  # "CPRS"
+
+
+@dataclasses.dataclass(frozen=True)
+class CompressionSpec:
+    """Frozen, seeded description of the wire codec — hashable, so it
+    can ride jit closures, and shared verbatim by the client (compress)
+    and server (decompress) ends of the wire."""
+
+    method: str = "none"
+    #: fraction of each leaf's entries the topk family keeps (>= 1 entry)
+    topk_frac: float = 0.01
+    #: seeded stochastic rounding for the int8 family (unbiased
+    #: quantizer; False = deterministic round-to-nearest)
+    stochastic: bool = True
+    #: carry the compression residual across rounds (see module doc)
+    error_feedback: bool = True
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.method not in METHODS:
+            raise ValueError(
+                f"compress method must be one of {METHODS}, "
+                f"got {self.method!r}"
+            )
+        if not (0.0 < self.topk_frac <= 1.0):
+            raise ValueError(
+                f"compress_topk_frac must be in (0, 1], "
+                f"got {self.topk_frac}"
+            )
+
+    def enabled(self) -> bool:
+        return self.method != "none"
+
+    @staticmethod
+    def from_fed(fed, seed: int = 0) -> "CompressionSpec":
+        """Build from :class:`~fedml_tpu.config.FedConfig` compress_*
+        fields (the single CLI/config surface; ``seed`` is the
+        experiment seed, so the stochastic-rounding stream is as
+        reproducible as every other draw)."""
+        return CompressionSpec(
+            method=fed.compress or "none",
+            topk_frac=fed.compress_topk_frac,
+            seed=seed,
+        )
+
+    def leaf_k(self, size: int) -> int:
+        """Top-k keep count for a leaf of ``size`` entries."""
+        return min(max(1, int(size * self.topk_frac)), size)
+
+
+# ---------------------------------------------------------------------------
+# per-leaf codec (single client; vmap over the client axis for stacks)
+# ---------------------------------------------------------------------------
+
+
+def _round(y: jax.Array, key: jax.Array | None) -> jax.Array:
+    """Round-to-nearest, or seeded stochastic rounding when a key is
+    given: ``floor(y + u)`` with ``u ~ U[0, 1)`` has ``E = y`` — the
+    quantizer itself is unbiased, independent of error feedback."""
+    if key is None:
+        return jnp.round(y)
+    return jnp.floor(y + jax.random.uniform(key, y.shape, y.dtype))
+
+
+def _quant_int8(x: jax.Array, key: jax.Array | None):
+    """``(q int8, scale f32)`` with per-tensor absmax scaling. An
+    all-zero tensor gets scale 0 and dequantizes to exact zeros."""
+    x = x.astype(jnp.float32)
+    a = jnp.max(jnp.abs(x)) if x.size else jnp.zeros((), jnp.float32)
+    scale = a / 127.0
+    safe = jnp.where(scale > 0, scale, 1.0)
+    q = jnp.clip(_round(x / safe, key), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def compress_leaf(spec: CompressionSpec, x: jax.Array,
+                  key: jax.Array | None) -> dict[str, jax.Array]:
+    """One leaf -> its typed wire payload (a small dict of arrays; the
+    bulk parts ride the native tensor-frame codec like any array)."""
+    if x.size == 0:
+        # degenerate leaf: nothing to compress, nothing to index
+        return {"dense": x}
+    if spec.method == "int8":
+        q, scale = _quant_int8(x, key)
+        return {"q": q, "scale": scale}
+    flat = jnp.ravel(x).astype(jnp.float32)
+    k = spec.leaf_k(flat.size)
+    # top-k by magnitude; lax.top_k's deterministic tie-break (lowest
+    # index wins) keeps the payload seeded-reproducible
+    _, idx = jax.lax.top_k(jnp.abs(flat), k)
+    idx = idx.astype(jnp.int32)
+    vals = flat[idx]
+    if spec.method == "topk":
+        return {"idx": idx, "vals": vals}
+    q, scale = _quant_int8(vals, key)  # topk_int8
+    return {"idx": idx, "q": q, "scale": scale}
+
+
+def decompress_leaf(spec: CompressionSpec, payload: dict,
+                    like: jax.Array) -> jax.Array:
+    """Inverse of :meth:`compress_leaf`, shaped/typed by ``like``."""
+    if "dense" in payload:
+        return payload["dense"].astype(like.dtype)
+    if spec.method == "int8":
+        out = payload["q"].astype(jnp.float32) * payload["scale"]
+        return out.reshape(like.shape).astype(like.dtype)
+    vals = (
+        payload["vals"]
+        if spec.method == "topk"
+        else payload["q"].astype(jnp.float32) * payload["scale"]
+    )
+    flat = jnp.zeros((like.size,), jnp.float32).at[payload["idx"]].set(
+        vals
+    )
+    return flat.reshape(like.shape).astype(like.dtype)
+
+
+# ---------------------------------------------------------------------------
+# pytree codec
+# ---------------------------------------------------------------------------
+
+
+def _leaf_keys(tree: Pytree, key: jax.Array | None):
+    leaves, treedef = jax.tree.flatten(tree)
+    if key is None:
+        return leaves, treedef, [None] * len(leaves)
+    return leaves, treedef, list(jax.random.split(key, len(leaves)))
+
+
+def compress_tree(spec: CompressionSpec, delta: Pytree,
+                  key: jax.Array | None) -> Pytree:
+    """Delta pytree -> payload pytree (each leaf becomes its payload
+    dict; structure otherwise preserved, so the payload pickles/stacks
+    like any pytree)."""
+    leaves, treedef, keys = _leaf_keys(
+        delta, key if spec.stochastic else None
+    )
+    return jax.tree.unflatten(
+        treedef,
+        [compress_leaf(spec, l, k) for l, k in zip(leaves, keys)],
+    )
+
+
+def decompress_tree(spec: CompressionSpec, payload: Pytree,
+                    template: Pytree) -> Pytree:
+    """Payload pytree -> delta pytree shaped like ``template``."""
+    t_leaves, treedef = jax.tree.flatten(template)
+    p_leaves = treedef.flatten_up_to(payload)
+    return jax.tree.unflatten(
+        treedef,
+        [decompress_leaf(spec, p, t)
+         for p, t in zip(p_leaves, t_leaves)],
+    )
+
+
+def roundtrip_tree(spec: CompressionSpec, delta: Pytree,
+                   key: jax.Array | None) -> Pytree:
+    """``decompress(compress(delta))`` — the exact wire arithmetic,
+    fused by XLA when traced (no payload materializes)."""
+    return decompress_tree(spec, compress_tree(spec, delta, key), delta)
+
+
+def apply_with_feedback(
+    spec: CompressionSpec, delta: Pytree, residual: Pytree | None,
+    key: jax.Array | None,
+) -> tuple[Pytree, Pytree, Pytree]:
+    """One client-side step of the compressed update: fold the carried
+    residual into the delta, compress, and compute the new residual.
+    Returns ``(payload, decompressed delta, new residual)`` — the
+    decompressed delta is what the server will aggregate, so callers
+    that only need the roundtrip (the sim) discard the payload and XLA
+    never materializes it."""
+    if residual is not None:
+        delta = jax.tree.map(
+            lambda d, r: d + r.astype(d.dtype), delta, residual
+        )
+    payload = compress_tree(spec, delta, key)
+    deq = decompress_tree(spec, payload, delta)
+    if spec.error_feedback:
+        # a non-finite delta (lr spike, bad batch) yields a non-finite
+        # payload the server's screen DROPS for this round — exactly
+        # the dense path's behavior. The carry must not memorize the
+        # poison: ``delta - deq`` would be NaN forever after, turning
+        # one bad round into permanent exclusion. Reset the whole
+        # carry for a non-finite round instead; the client recovers
+        # next round like its dense twin (pinned in
+        # tests/test_compress.py).
+        ok = jnp.asarray(True)
+        for x in jax.tree.leaves(delta):
+            if jnp.issubdtype(x.dtype, jnp.floating):
+                ok = ok & jnp.all(jnp.isfinite(x))
+        new_residual = jax.tree.map(
+            lambda d, q: jnp.where(ok, d - q, jnp.zeros((), d.dtype)),
+            delta, deq,
+        )
+    else:
+        new_residual = jax.tree.map(jnp.zeros_like, delta)
+    return payload, deq, new_residual
+
+
+# ---------------------------------------------------------------------------
+# stacked [C, ...] forms (the server / sim sides)
+# ---------------------------------------------------------------------------
+
+
+def slot_key(spec: CompressionSpec, rkey: jax.Array,
+             slot) -> jax.Array:
+    """One slot's quantizer key for one round, folded off the round
+    key under the codec's own salt (deterministic; disjoint from the
+    sampling/noise streams). The deploy client calls it with its
+    cohort slot (``rank - 1``); the sim vmaps it over the bucket."""
+    base = jax.random.fold_in(
+        jax.random.fold_in(rkey, _KEY_SALT), spec.seed
+    )
+    return jax.random.fold_in(base, slot)
+
+
+def round_keys(spec: CompressionSpec, rkey: jax.Array,
+               n: int) -> jax.Array:
+    """Per-slot quantizer keys for one round (:func:`slot_key` over
+    the bucket)."""
+    return jax.vmap(lambda i: slot_key(spec, rkey, i))(jnp.arange(n))
+
+
+def roundtrip_stacked(
+    spec: CompressionSpec, stacked_delta: Pytree,
+    residual: Pytree | None, rkey: jax.Array,
+) -> tuple[Pytree, Pytree]:
+    """The sim-side wire model: per-slot compress->decompress with
+    error feedback, vmapped over the client axis inside the compiled
+    round. Returns ``(decompressed stacked delta, new stacked
+    residual)`` — the same arithmetic the deploy path's per-client
+    sends see, at stacked layout."""
+    n = jax.tree.leaves(stacked_delta)[0].shape[0]
+    keys = round_keys(spec, rkey, n)
+
+    def one(delta, res, key):
+        _, deq, new_res = apply_with_feedback(spec, delta, res, key)
+        return deq, new_res
+
+    if residual is None:
+        return jax.vmap(lambda d, k: one(d, None, k))(
+            stacked_delta, keys
+        )
+    return jax.vmap(one)(stacked_delta, residual, keys)
+
+
+def decompress_stacked(spec: CompressionSpec, stacked_payload: Pytree,
+                       template: Pytree) -> Pytree:
+    """Server side: stacked payload tree (leaves ``[C, ...]``) ->
+    stacked dense delta ``[C, ...]`` shaped like ``template`` (the
+    global variables). Pure jax — runs inside the compiled (and
+    optionally client-axis-sharded) aggregation program."""
+    return jax.vmap(
+        lambda p: decompress_tree(spec, p, template)
+    )(stacked_payload)
+
+
+def zero_residual(template: Pytree, n: int) -> Pytree:
+    """Fresh ``[n, ...]`` error-feedback carry for ``n`` slots."""
+    return jax.tree.map(
+        lambda g: jnp.zeros((n,) + np.shape(g), g.dtype), template
+    )
+
+
+def pad_stacked_payload(stacked_payload: Pytree, bucket: int) -> Pytree:
+    """Pad every payload leaf to ``bucket`` rows with zeros. A zero
+    payload row (indices 0, values 0, scale 0) decompresses to a delta
+    of exactly zero — the healed-row convention of
+    :func:`fedml_tpu.core.elastic.pad_stacked`, so bucket padding and
+    compression compose."""
+
+    def leaf(x):
+        x = jnp.asarray(x)
+        c = x.shape[0]
+        if c > bucket:
+            raise ValueError(f"cohort {c} does not fit bucket {bucket}")
+        if c == bucket:
+            return x
+        pad = jnp.zeros((bucket - c,) + x.shape[1:], x.dtype)
+        return jnp.concatenate([x, pad], axis=0)
+
+    return jax.tree.map(leaf, stacked_payload)
+
+
+# ---------------------------------------------------------------------------
+# host-side wire accounting + validation (the server's receive edge)
+# ---------------------------------------------------------------------------
+
+
+def _leaf_dtype(leaf) -> np.dtype:
+    """Leaf dtype without materializing device arrays host-side."""
+    dt = getattr(leaf, "dtype", None)
+    return np.dtype(dt) if dt is not None else np.asarray(leaf).dtype
+
+
+def _leaf_payload_bytes(spec: CompressionSpec, leaf) -> int:
+    size = int(np.prod(np.shape(leaf))) if np.shape(leaf) else 1
+    if size == 0 or not spec.enabled():
+        return size * _leaf_dtype(leaf).itemsize
+    if spec.method == "int8":
+        return size * 1 + 4
+    k = spec.leaf_k(size)
+    if spec.method == "topk":
+        return k * (4 + 4)
+    return k * (4 + 1) + 4  # topk_int8
+
+
+def wire_ratio(spec: CompressionSpec, template: Pytree) -> float:
+    """Analytic dense/compressed byte ratio for a variables tree —
+    the ``compress.ratio`` gauge (payload tensors only; envelope
+    overhead is shared by both paths and excluded)."""
+    leaves = jax.tree.leaves(template)
+    dense = sum(
+        int(np.prod(np.shape(l))) * _leaf_dtype(l).itemsize
+        for l in leaves
+    )
+    compressed = sum(_leaf_payload_bytes(spec, l) for l in leaves)
+    return dense / max(1, compressed)
+
+
+@dataclasses.dataclass(frozen=True)
+class _LeafSpec:
+    """Expected payload parts for one leaf: ``{part: (shape, dtype)}``
+    plus the dense extent top-k indices scatter into. A distinct class
+    (not a bare dict) so template flattening can tell a payload leaf
+    from the variables tree's own dict structure."""
+
+    parts: dict
+    dense_size: int | None = None
+
+
+def payload_template(spec: CompressionSpec, variables: Pytree) -> Pytree:
+    """The expected payload structure for a variables tree: leaf ->
+    :class:`_LeafSpec` — what :func:`validate_payload` checks inbound
+    results against."""
+
+    def leaf(g):
+        size = int(np.prod(np.shape(g)))
+        if size == 0:
+            return _LeafSpec({"dense": (np.shape(g), _leaf_dtype(g))})
+        if spec.method == "int8":
+            return _LeafSpec({
+                "q": (np.shape(g), np.dtype(np.int8)),
+                "scale": ((), np.dtype(np.float32)),
+            })
+        k = spec.leaf_k(size)
+        parts = {"idx": ((k,), np.dtype(np.int32))}
+        if spec.method == "topk":
+            parts["vals"] = ((k,), np.dtype(np.float32))
+        else:
+            parts["q"] = ((k,), np.dtype(np.int8))
+            parts["scale"] = ((), np.dtype(np.float32))
+        return _LeafSpec(parts, dense_size=size)
+
+    return jax.tree.map(leaf, variables)
+
+
+def validate_payload(template: Pytree, payload: Pytree) -> str | None:
+    """Structural + finiteness screen for one inbound compressed
+    result, host-side at the receive edge (the compressed twin of the
+    dense path's ``_result_is_finite``). Returns a diagnostic string
+    for a payload that must be DROPPED (counted
+    ``compress.decode_errors``), else None.
+
+    Checks: tree structure matches the spec's expected payload shape,
+    every part has the expected shape/dtype, float parts are finite,
+    and top-k indices are in range (an out-of-range index would make
+    the compiled scatter silently drop updates)."""
+    t_leaves, treedef = jax.tree.flatten(
+        template, is_leaf=lambda x: isinstance(x, _LeafSpec)
+    )
+    try:
+        p_leaves = treedef.flatten_up_to(payload)
+    except (ValueError, TypeError) as err:
+        return f"payload tree mismatch: {err}"
+    for t, p in zip(t_leaves, p_leaves):
+        dense_size = t.dense_size
+        expected = t.parts
+        if not isinstance(p, dict) or set(p) != set(expected):
+            got = sorted(p) if isinstance(p, dict) else type(p).__name__
+            return f"payload keys {got} != expected {sorted(expected)}"
+        for name, (shape, dtype) in expected.items():
+            arr = np.asarray(p[name])
+            if tuple(arr.shape) != tuple(shape):
+                return (
+                    f"part {name!r} shape {arr.shape} != {tuple(shape)}"
+                )
+            if arr.dtype != dtype:
+                return f"part {name!r} dtype {arr.dtype} != {dtype}"
+            if np.issubdtype(arr.dtype, np.floating) and not np.all(
+                np.isfinite(arr)
+            ):
+                return f"part {name!r} carries non-finite values"
+        if "idx" in expected and dense_size is not None:
+            idx = np.asarray(p["idx"])
+            if idx.size and (
+                idx.min() < 0 or idx.max() >= dense_size
+            ):
+                # an out-of-range index would make the compiled
+                # scatter silently drop (or alias) updates
+                return (
+                    f"idx out of range for dense size {dense_size}"
+                )
+        if "scale" in expected:
+            # the DEQUANTIZED values must stay finite too: a finite
+            # scale near f32 max overflows q * scale to inf, and the
+            # norm-clip then turns inf * 0 into NaN inside the
+            # aggregate — the exact single-result poisoning the dense
+            # path's receive screen rejects. Scales are absmax/127 by
+            # construction, so negative is equally malformed.
+            s = np.float32(np.asarray(p["scale"]))
+            with np.errstate(over="ignore"):
+                # the product must be taken in f32 — in python floats
+                # 3e38 * 127 is still finite and the overflow hides
+                biggest = s * np.float32(127.0)
+            if s < 0.0 or not np.isfinite(biggest):
+                return (
+                    f"scale {float(s)!r} dequantizes out of f32 range"
+                )
+    return None

@@ -167,9 +167,16 @@ class CompiledRoundCache:
     transport dispatch threads."""
 
     def __init__(self, fn: Callable, max_entries: int = 8,
-                 static_argnums=()):
+                 static_argnums=(), jit_kwargs: dict | None = None):
+        """``jit_kwargs`` passes straight through to ``jax.jit`` —
+        the sharded-aggregation path uses it for
+        ``in_shardings``/``out_shardings`` (client-axis NamedSharding);
+        ``donate_argnums`` is accepted for callers whose operands have
+        a single owner (the actor paths deliberately do not donate —
+        see parallel/sharded_agg.py)."""
         self._fn = fn
         self._static_argnums = tuple(static_argnums)
+        self._jit_kwargs = dict(jit_kwargs or {})
         self.max_entries = max_entries
         self._cache: OrderedDict[int, object] = OrderedDict()
         self._lock = threading.Lock()
@@ -184,7 +191,8 @@ class CompiledRoundCache:
                 self._cache.move_to_end(bucket)
         if exe is None:
             exe = (
-                jax.jit(self._fn, static_argnums=self._static_argnums)
+                jax.jit(self._fn, static_argnums=self._static_argnums,
+                        **self._jit_kwargs)
                 .lower(*args)
                 .compile()
             )

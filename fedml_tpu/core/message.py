@@ -71,11 +71,41 @@ MSG_TYPE_S2C_WELCOME = 9
 # client id and they enter the cohort at the next round boundary).
 MSG_TYPE_C2S_LEAVE = 10
 
+#: symbolic names for the per-type wire-byte counters
+#: (``transport.bytes_by_type.<name>``, docs/OBSERVABILITY.md): byte
+#: reduction claims must be attributable to the DELTA payloads
+#: (``c2s_result``) specifically — heartbeats/ACKs ride the same sealed
+#: frames and would otherwise pollute the measurement.
+MSG_TYPE_NAMES = {
+    MSG_TYPE_S2C_INIT: "s2c_init",
+    MSG_TYPE_S2C_SYNC_MODEL: "s2c_sync_model",
+    MSG_TYPE_C2S_RESULT: "c2s_result",
+    MSG_TYPE_FINISH: "finish",
+    MSG_TYPE_C2S_READY: "c2s_ready",
+    MSG_TYPE_S2C_ACK: "s2c_ack",
+    MSG_TYPE_HEARTBEAT: "heartbeat",
+    MSG_TYPE_C2S_JOIN: "c2s_join",
+    MSG_TYPE_S2C_WELCOME: "s2c_welcome",
+    MSG_TYPE_C2S_LEAVE: "c2s_leave",
+}
+
+
+def msg_type_name(msg_type: int) -> str:
+    """Symbolic name for a message type (algorithm-specific types fall
+    back to their integer)."""
+    return MSG_TYPE_NAMES.get(msg_type, str(msg_type))
+
+
 # Well-known payload keys (reference Message.MSG_ARG_KEY_*)
 KEY_MODEL_PARAMS = "model_params"
 KEY_NUM_SAMPLES = "num_samples"
 KEY_CLIENT_INDEX = "client_index"
 KEY_ROUND = "round_idx"
+# typed compressed-delta payload (core/compress.py): replaces
+# KEY_MODEL_PARAMS on C2S_RESULT messages when the wire codec is on —
+# {"codec": method, "payload": <payload pytree>}. The dense path never
+# adds the key, so --compress none stays byte-identical on the wire.
+KEY_COMPRESSED = "compressed_delta"
 
 
 @dataclasses.dataclass

@@ -179,16 +179,34 @@ def flatten_clients(stacked: Pytree) -> jax.Array:
     return x.astype(jnp.float32)
 
 
+def pairwise_sq_dists_rows(x_rows: jax.Array, rows: jax.Array,
+                           x_all: jax.Array) -> jax.Array:
+    """``[R, C]`` row block of the squared-distance matrix: distances
+    from ``x_rows`` (rows ``rows`` of the cohort) to every client in
+    ``x_all``. This is the mesh-sharded form of
+    :func:`pairwise_sq_dists` — each shard computes ONLY its own rows'
+    block (``x_loc @ x_all.T``), so the ``O(C^2 D)`` gram that
+    dominates Krum at C=1000 partitions over the client axis while the
+    per-element dot products keep the full, unpartitioned ``D``
+    contraction (the reassociation-free property the bitwise
+    sharded-vs-replicated selection parity rests on)."""
+    sq_r = jnp.sum(x_rows * x_rows, axis=1)
+    sq_a = jnp.sum(x_all * x_all, axis=1)
+    d2 = sq_r[:, None] + sq_a[None, :] - 2.0 * (x_rows @ x_all.T)
+    d2 = jnp.maximum(d2, 0.0)  # float error can dip negative
+    eye = rows[:, None] == jnp.arange(x_all.shape[0])[None, :]
+    return d2 * (1.0 - eye.astype(d2.dtype))  # exact-zero self slots
+
+
 def pairwise_sq_dists(stacked: Pytree) -> jax.Array:
     """``[C, C]`` squared L2 distances between client deltas, computed
     as ONE gram matmul over the flattened ``[C, D]`` deltas (never a
-    python double loop): ``d2_ij = |x_i|^2 + |x_j|^2 - 2 x_i.x_j``."""
+    python double loop): ``d2_ij = |x_i|^2 + |x_j|^2 - 2 x_i.x_j``.
+    The full-matrix special case of :func:`pairwise_sq_dists_rows`
+    (one implementation, so the replicated and row-sharded paths
+    cannot drift)."""
     x = flatten_clients(stacked)
-    sq = jnp.sum(x * x, axis=1)
-    d2 = sq[:, None] + sq[None, :] - 2.0 * (x @ x.T)
-    d2 = jnp.maximum(d2, 0.0)  # float error can dip negative
-    c = x.shape[0]
-    return d2 * (1.0 - jnp.eye(c, dtype=d2.dtype))  # exact-zero diagonal
+    return pairwise_sq_dists_rows(x, jnp.arange(x.shape[0]), x)
 
 
 #: large-but-finite stand-in for "not a neighbor" in the Krum scores —
@@ -219,10 +237,28 @@ def krum_scores(d2: jax.Array, num_adversaries: int,
     (1e30 absorbs the real distances in f32 and the argmin degenerates
     to row 0). Invalid rows score ``+inf`` so they can never win a
     selection regardless of how small the valid cohort gets."""
-    c = d2.shape[0]
+    return krum_scores_rows(
+        d2, jnp.arange(d2.shape[0]), num_adversaries, valid, n_valid
+    )
+
+
+def krum_scores_rows(d2: jax.Array, rows: jax.Array,
+                     num_adversaries: int,
+                     valid: jax.Array | None = None,
+                     n_valid: jax.Array | None = None) -> jax.Array:
+    """:func:`krum_scores` for a ROW BLOCK of the distance matrix:
+    ``d2`` is ``[R, C]`` (this shard's rows against the full cohort),
+    ``rows`` the rows' global indices, ``valid`` the FULL ``[C]``
+    eligibility mask. Each row's score involves only its own distance
+    row — exactly the ops the full-matrix path applies to that row —
+    so stacking the shards' blocks reproduces the replicated scores
+    bitwise (the sharded-vs-replicated parity
+    ``tests/test_compress.py`` pins)."""
+    c = d2.shape[1]
     if valid is not None:
-        pair_ok = valid[:, None] & valid[None, :]
-        pair_ok = pair_ok | jnp.eye(c, dtype=bool)  # keep self 0
+        pair_ok = valid[rows][:, None] & valid[None, :]
+        # keep the exact-zero self distance
+        pair_ok = pair_ok | (rows[:, None] == jnp.arange(c)[None, :])
         d2 = jnp.where(pair_ok, d2, _FAR)
     s = jnp.sort(d2, axis=1)  # column 0 is the exact-zero self distance
     if n_valid is None:
@@ -234,27 +270,33 @@ def krum_scores(d2: jax.Array, num_adversaries: int,
     sel = (cols >= 1) & (cols <= k)
     scores = jnp.sum(jnp.where(sel[None, :], s, 0.0), axis=1)
     if valid is not None:
-        scores = jnp.where(valid, scores, jnp.inf)
+        scores = jnp.where(valid[rows], scores, jnp.inf)
     return scores
 
 
 def krum(stacked: Pytree, num_adversaries: int,
          weights: jax.Array | None = None,
-         n_valid: jax.Array | None = None
+         n_valid: jax.Array | None = None,
+         scores: jax.Array | None = None,
          ) -> tuple[Pytree, jax.Array, jax.Array]:
     """Krum selection: return ``(selected delta, scores, best index)``
     — the single most central client's delta IS the aggregate. Rows
     with zero ``weights`` are never selected. ``n_valid`` (traced)
-    switches to the dynamic neighbor count for bucket-padded cohorts."""
-    valid = None if weights is None else weights > 0
-    scores = krum_scores(pairwise_sq_dists(stacked), num_adversaries,
-                         valid, n_valid)
+    switches to the dynamic neighbor count for bucket-padded cohorts.
+    ``scores`` short-circuits the distance computation — the
+    mesh-sharded path precomputes them blockwise
+    (:func:`krum_scores_rows`) and hands the gathered vector in."""
+    if scores is None:
+        valid = None if weights is None else weights > 0
+        scores = krum_scores(pairwise_sq_dists(stacked),
+                             num_adversaries, valid, n_valid)
     best = jnp.argmin(scores)
     return jax.tree.map(lambda x: x[best], stacked), scores, best
 
 
 def multi_krum(stacked: Pytree, weights: jax.Array, num_adversaries: int,
-               m: int = 0, n_valid: jax.Array | None = None
+               m: int = 0, n_valid: jax.Array | None = None,
+               scores: jax.Array | None = None,
                ) -> tuple[Pytree, jax.Array, jax.Array]:
     """Multi-Krum: weighted mean over the ``m`` best-scored clients
     (``m = 0`` auto-resolves to ``C - f``, clamped to ``[1, C]``).
@@ -265,11 +307,14 @@ def multi_krum(stacked: Pytree, weights: jax.Array, num_adversaries: int,
     ``n_valid`` (traced) makes BOTH the neighbor count and the auto
     keep count derive from the valid row count — on a bucket-padded
     cohort the static ``C - f`` would keep every valid row plus padded
-    debris instead of dropping the ``f`` most suspect valid rows."""
+    debris instead of dropping the ``f`` most suspect valid rows.
+    ``scores`` short-circuits the distance computation (the
+    mesh-sharded blockwise path)."""
     c = jax.tree.leaves(stacked)[0].shape[0]
     f = num_adversaries
-    scores = krum_scores(pairwise_sq_dists(stacked), f, weights > 0,
-                         n_valid)
+    if scores is None:
+        scores = krum_scores(pairwise_sq_dists(stacked), f, weights > 0,
+                             n_valid)
     if n_valid is None:
         m_eff = m if m > 0 else max(1, c - f)
         m_eff = max(1, min(m_eff, c))
@@ -537,11 +582,16 @@ class DefensePipeline:
             # padding mask authoritative even if a live client ever
             # reported a zero sample count
             gw = jnp.where(gv, gw, 0.0)
-        if self.method == "krum":
-            return krum(g, self.num_adversaries, gw, n_valid)[0]
-        if self.method == "multikrum":
+        if self.method in ("krum", "multikrum"):
+            scores = self._sharded_krum_scores(
+                deltas, g, gw, red, self.num_adversaries, n_valid
+            )
+            if self.method == "krum":
+                return krum(g, self.num_adversaries, gw, n_valid,
+                            scores=scores)[0]
             return multi_krum(
-                g, gw, self.num_adversaries, self.multikrum_m, n_valid
+                g, gw, self.num_adversaries, self.multikrum_m, n_valid,
+                scores=scores,
             )[0]
         if self.method == "fltrust":
             # no server root dataset in the loop: the reference delta
@@ -549,6 +599,32 @@ class DefensePipeline:
             # to a minority of adversaries by construction)
             return fltrust(g, coordinate_median(g, gv), weights=gw)[0]
         raise ValueError(f"unknown defense method: {self.method!r}")
+
+    @staticmethod
+    def _sharded_krum_scores(local_deltas, gathered, gw, red,
+                             num_adversaries,
+                             n_valid) -> jax.Array | None:
+        """Row-block Krum scores when the reduce runs over a mesh axis
+        (``red.axis``): each shard computes ITS rows' block of the
+        ``O(C^2 D)`` gram against the gathered stack
+        (:func:`pairwise_sq_dists_rows`) and only the ``[C]`` score
+        vector is all-gathered — the distance work partitions over the
+        client axis instead of replicating on every device. Per row
+        the ops are identical to the replicated path, so the selection
+        stays bitwise (parity pinned in ``tests/test_compress.py``).
+        Returns None on a local reduce (the replicated path computes
+        its own scores)."""
+        axis = getattr(red, "axis", None)
+        if axis is None:
+            return None
+        x_rows = flatten_clients(local_deltas)
+        x_all = flatten_clients(gathered)
+        b = x_rows.shape[0]
+        rows = jax.lax.axis_index(axis) * b + jnp.arange(b)
+        d2_rows = pairwise_sq_dists_rows(x_rows, rows, x_all)
+        scores_rows = krum_scores_rows(d2_rows, rows, num_adversaries,
+                                       gw > 0, n_valid)
+        return jax.lax.all_gather(scores_rows, axis, tiled=True)
 
     def postprocess(self, agg: Pytree, rng: jax.Array) -> Pytree:
         return (

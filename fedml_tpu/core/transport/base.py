@@ -18,7 +18,21 @@ import threading
 from typing import Callable, Protocol
 
 from fedml_tpu.core import telemetry
-from fedml_tpu.core.message import Message
+from fedml_tpu.core.message import Message, msg_type_name
+
+#: metric-name cache for the per-type byte counters: one small string
+#: per DISTINCT message type, so the enabled hot path still allocates
+#: no per-message strings (docs/OBSERVABILITY.md vocabulary)
+_BYTES_BY_TYPE: dict[int, str] = {}
+
+
+def _bytes_by_type_metric(msg_type: int) -> str:
+    name = _BYTES_BY_TYPE.get(msg_type)
+    if name is None:
+        name = _BYTES_BY_TYPE[msg_type] = (
+            f"transport.bytes_by_type.{msg_type_name(msg_type)}"
+        )
+    return name
 
 
 class Observer(Protocol):
@@ -67,20 +81,28 @@ class BaseTransport(abc.ABC):
     # -- telemetry (docs/OBSERVABILITY.md) ---------------------------------
     def note_send(self, msg: Message, nbytes: int) -> None:
         """Account one outbound wire frame. Every concrete transport
-        calls this once per send with the encoded frame size."""
+        calls this once per send with the encoded frame size. Bytes are
+        also attributed per message type
+        (``transport.bytes_by_type.<name>``) so a wire-reduction claim
+        can name the payload class it shrank."""
         m = telemetry.METRICS
         if m.enabled:
             m.inc("transport.messages_sent")
             m.inc("transport.bytes_sent", nbytes)
+            m.inc(_bytes_by_type_metric(msg.msg_type), nbytes)
 
-    def note_receive(self, nbytes: int) -> None:
+    def note_receive(self, nbytes: int, msg_type: int | None = None) -> None:
         """Account one inbound wire frame — called at the transport's
         decode site (real I/O), NOT in :meth:`deliver`, so a wrapping
-        transport (chaos) never double-counts."""
+        transport (chaos) never double-counts. ``msg_type`` (known only
+        after a successful decode; None for frames dropped before
+        decode, e.g. CRC failures) feeds the per-type attribution."""
         m = telemetry.METRICS
         if m.enabled:
             m.inc("transport.messages_received")
             m.inc("transport.bytes_received", nbytes)
+            if msg_type is not None:
+                m.inc(_bytes_by_type_metric(msg_type), nbytes)
 
     def deliver(self, msg: Message) -> None:
         """Called by receiver machinery (or peers, for loopback)."""

@@ -49,8 +49,9 @@ class GrpcTransport(BaseTransport):
         grpc = self._grpc
 
         def handler(request: bytes, context) -> bytes:
-            self.note_receive(len(request))
-            self.deliver(Message.decode(request))
+            msg = Message.decode(request)
+            self.note_receive(len(request), msg.msg_type)
+            self.deliver(msg)
             return b""
 
         generic = grpc.method_handlers_generic_handler(

@@ -31,5 +31,6 @@ class LoopbackTransport(BaseTransport):
         data = msg.encode()
         self.note_send(msg, len(data))
         peer = self.hub.transports[msg.receiver]
-        peer.note_receive(len(data))
-        peer.deliver(Message.decode(data))
+        decoded = Message.decode(data)
+        peer.note_receive(len(data), decoded.msg_type)
+        peer.deliver(decoded)

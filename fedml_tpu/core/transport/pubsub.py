@@ -143,10 +143,10 @@ class PubSubTransport(BaseTransport):
         )
 
     def _on_message(self, topic: str, payload: bytes) -> None:
-        self.note_receive(len(payload))
         try:
             data = wire.open_sealed(payload)
         except wire.CorruptFrameError:
+            self.note_receive(len(payload))
             # damaged between publisher and subscriber (the broker
             # daemon routes payloads untouched, so the seal is
             # end-to-end): count + drop — QoS-0 semantics make the
@@ -157,6 +157,7 @@ class PubSubTransport(BaseTransport):
             )
             return
         except wire.WireVersionError as err:
+            self.note_receive(len(payload))
             telemetry.flight_dump(
                 "wire_version_mismatch", rank=self.rank,
                 detail=str(err),
@@ -164,7 +165,9 @@ class PubSubTransport(BaseTransport):
             print(f"rank {self.rank}: {err}", file=sys.stderr)
             self.stop()
             return
-        self.deliver(self._inflate(Message.decode(data)))
+        msg = Message.decode(data)
+        self.note_receive(len(payload), msg.msg_type)
+        self.deliver(self._inflate(msg))
 
     def _deflate(self, msg: Message) -> Message:
         return msg  # plain MQTT: whole message on the topic

@@ -108,10 +108,10 @@ class TcpTransport(BaseTransport):
                 data = _recv_exact(conn, length)
                 if data is None:
                     return
-                self.note_receive(_HDR.size + length)
                 try:
                     payload = wire.open_sealed(data)
                 except wire.CorruptFrameError:
+                    self.note_receive(_HDR.size + length)
                     # damaged in flight: count + drop; the length
                     # prefix framed the stream correctly, so the next
                     # frame parses — and the fault-tolerance layer
@@ -128,6 +128,7 @@ class TcpTransport(BaseTransport):
                     # (stop unblocks the actor's run loop into its
                     # incomplete-run error) instead of silently
                     # dropping traffic forever
+                    self.note_receive(_HDR.size + length)
                     telemetry.flight_dump(
                         "wire_version_mismatch", rank=self.rank,
                         detail=str(err),
@@ -135,7 +136,9 @@ class TcpTransport(BaseTransport):
                     print(f"rank {self.rank}: {err}", file=sys.stderr)
                     self.stop()
                     return
-                self.deliver(Message.decode(payload))
+                msg = Message.decode(payload)
+                self.note_receive(_HDR.size + length, msg.msg_type)
+                self.deliver(msg)
 
     # -- send side ---------------------------------------------------------
     def _rank_lock(self, rank: int) -> threading.Lock:

@@ -77,8 +77,11 @@ class TensorRpcTransport(TcpTransport):
                 meta = _recv_exact(conn, meta_len)
                 if meta is None:
                     return
-                self.note_receive(2 * _HDR.size + frame_len + meta_len)
-                self.deliver(Message.from_parts(meta, frame))
+                msg = Message.from_parts(meta, frame)
+                self.note_receive(
+                    2 * _HDR.size + frame_len + meta_len, msg.msg_type
+                )
+                self.deliver(msg)
 
 
 def benchmark_transport(

@@ -610,6 +610,11 @@ def _run_fedavg_rank(cfg: ExperimentConfig, dep: DeployConfig) -> dict:
             # beyond the launch world
             "membership": server.membership,
             "elastic": bool(cfg.fed.elastic_buckets),
+            # the wire codec + aggregation layout in force
+            # (docs/PERFORMANCE.md "Wire compression"): reduction
+            # claims must be checkable against what actually ran
+            "compress": cfg.fed.compress,
+            "shard_aggregation": bool(cfg.fed.shard_aggregation),
             **metrics,
         }
 
@@ -665,6 +670,15 @@ def _run_splitnn_rank(cfg: ExperimentConfig, dep: DeployConfig) -> dict:
             "warning: --adversary_* flags are ignored by splitnn "
             "ranks (adversary injection covers the fedavg-family "
             "client actor only)",
+            file=_sys.stderr,
+        )
+    if cfg.fed.compress != "none" or cfg.fed.shard_aggregation:
+        import sys as _sys
+
+        print(
+            "warning: --compress / --shard_aggregation are ignored by "
+            "splitnn ranks (the compressed + sharded weight-update "
+            "path covers the fedavg family only)",
             file=_sys.stderr,
         )
     data = load_dataset(cfg.data)

@@ -81,6 +81,29 @@ def parse_args(argv=None) -> tuple[ExperimentConfig, argparse.Namespace]:
                         "below C=10)")
     p.add_argument("--robust_norm_clip", type=float, default=None)
     p.add_argument("--robust_noise_stddev", type=float, default=None)
+    # -- compressed + sharded weight-update path (core/compress.py,
+    # parallel/sharded_agg.py; docs/PERFORMANCE.md) -------------------------
+    p.add_argument("--compress", type=str, default=None,
+                   choices=["none", "int8", "topk", "topk_int8"],
+                   help="wire codec for the client->server delta "
+                        "payload: int8 absmax quantization, top-k "
+                        "sparsification, or both — with client-side "
+                        "error feedback so compression error is "
+                        "telescoping carry, not bias. 'none' (default) "
+                        "keeps the dense wire byte-identical. Applies "
+                        "to the fedavg-family sim and --role paths; "
+                        "set it identically on EVERY rank of a world")
+    p.add_argument("--compress_topk_frac", type=float, default=None,
+                   help="fraction of each leaf's entries the topk "
+                        "family keeps (>= 1 entry per leaf)")
+    p.add_argument("--shard_aggregation", action="store_true",
+                   help="server rank: shard the aggregation pass "
+                        "(decompress -> clip -> defense-reduce -> "
+                        "optimizer step) over the client axis of a "
+                        "mesh spanning this host's devices, "
+                        "all-gathering only the final params "
+                        "(parallel/sharded_agg.py; the sims' sharded "
+                        "runtime is ShardedFedAvg)")
     # -- seeded Byzantine adversary injection (core/adversary.py) ----------
     p.add_argument("--adversary_mode", type=str, default=None,
                    choices=["none", "sign_flip", "scale_boost", "gauss",
@@ -328,6 +351,9 @@ def parse_args(argv=None) -> tuple[ExperimentConfig, argparse.Namespace]:
             robust_multikrum_m=a.defense_multikrum_m,
             robust_trim_frac=a.defense_trim_frac,
             elastic_buckets=True if a.elastic else None,
+            compress=a.compress,
+            compress_topk_frac=a.compress_topk_frac,
+            shard_aggregation=True if a.shard_aggregation else None,
             profile_rounds=a.profile_rounds,
         ),
         adversary=rep(
@@ -349,11 +375,13 @@ def parse_args(argv=None) -> tuple[ExperimentConfig, argparse.Namespace]:
     # threshold off would otherwise crash the server actor at
     # construction): under --supervise a construction-time ValueError
     # would crash-loop the server through its whole restart budget
+    from fedml_tpu.core.compress import CompressionSpec
     from fedml_tpu.core.reputation import QuarantinePolicy
     from fedml_tpu.core.robust import DefensePipeline, check_fednova_compat
 
     try:
         DefensePipeline.from_fed(cfg.fed)
+        CompressionSpec.from_fed(cfg.fed)
         QuarantinePolicy(threshold=a.quarantine_threshold,
                          decay=a.quarantine_decay,
                          evict_after=a.quarantine_evict_after)
@@ -609,6 +637,15 @@ def main(argv=None) -> int:
             "set_cohort_size drives churn in the simulator)",
             file=sys.stderr,
         )
+    if cfg.fed.shard_aggregation:
+        # the sharded server update lives in the deploy server actor;
+        # the sims' sharded runtime is ShardedFedAvg (library API)
+        print(
+            "warning: --shard_aggregation covers the --role server "
+            "aggregation path and is ignored by the simulator "
+            "(parallel.ShardedFedAvg is the sims' sharded runtime)",
+            file=sys.stderr,
+        )
     # adversary injection is wired into the FedAvgSim round program;
     # other sims (mpc/secure-agg, GAN family, splitnn, ...) aggregate
     # elsewhere and would silently run a vacuous Byzantine experiment
@@ -621,6 +658,18 @@ def main(argv=None) -> int:
             f"{cfg.fed.algorithm!r} simulator (adversary injection "
             "covers the FedAvg-family round program: "
             f"{sorted(_ADVERSARY_SIMS)})",
+            file=sys.stderr,
+        )
+    if (cfg.fed.compress != "none"
+            and cfg.fed.algorithm not in _ADVERSARY_SIMS):
+        # same honesty rule as the adversary gate: only the
+        # FedAvg-family round wires the codec in — a summary labeled
+        # topk_int8 must not have measured a dense run
+        print(
+            f"warning: --compress is ignored by the "
+            f"{cfg.fed.algorithm!r} simulator (the wire codec covers "
+            "the FedAvg-family round program: "
+            f"{sorted(_ADVERSARY_SIMS)}); results here are DENSE",
             file=sys.stderr,
         )
     if (a.telemetry_dir or a.trace or a.trace_jax

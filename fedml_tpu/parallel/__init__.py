@@ -15,5 +15,6 @@ The reference's scale-out axes (SURVEY.md §2.7) map onto a
 All collectives are XLA collectives riding ICI; no NCCL/MPI anywhere.
 """
 
-from fedml_tpu.parallel.mesh import make_mesh
+from fedml_tpu.parallel.mesh import make_client_mesh, make_mesh
 from fedml_tpu.parallel.client_parallel import ShardedFedAvg
+from fedml_tpu.parallel.sharded_agg import ShardedAggregator

@@ -87,6 +87,18 @@ class ShardedFedAvg(FedAvgSim):
                 "FedAvgSim and the deploy-path client actor); run the "
                 "Byzantine scenario there, or disable cfg.adversary"
             )
+        if cfg.fed.compress != "none":
+            # same honesty rule as the adversary gate: this runtime's
+            # client<->server "wire" is the mesh ICI (psum/all_gather)
+            # — there is no serialized delta payload to compress, and
+            # silently skipping the codec would report compressed-run
+            # results that measured a dense run
+            raise ValueError(
+                "wire compression is not wired into the mesh-sharded "
+                "round (its aggregation rides ICI collectives, not a "
+                "serialized wire); model the codec on FedAvgSim or "
+                "the --role deploy path, or set compress='none'"
+            )
         self.mesh = mesh
         self.client_axis = cfg.mesh.client_axis_name
         self.data_axis = cfg.mesh.data_axis_name

@@ -30,3 +30,27 @@ def make_mesh(
         client_axis, data_axis
     )
     return Mesh(grid, axis_names)
+
+
+def make_client_mesh(
+    n_devices: int | None = None,
+    axis_name: str = "clients",
+    devices=None,
+) -> Mesh:
+    """1-D mesh over the client axis — the layout of the sharded
+    server-aggregation path (:mod:`fedml_tpu.parallel.sharded_agg`):
+    the stacked ``[C, ...]`` client deltas partition row-wise over
+    these devices, and only the final params are gathered back.
+
+    ``n_devices=None`` uses every local device (a server process
+    aggregating for a world of remote clients owns the whole host's
+    accelerators)."""
+    devices = list(devices if devices is not None else jax.devices())
+    if n_devices is not None:
+        if not (1 <= n_devices <= len(devices)):
+            raise ValueError(
+                f"client mesh wants {n_devices} devices; "
+                f"{len(devices)} available"
+            )
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (axis_name,))

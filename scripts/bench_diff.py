@@ -40,7 +40,7 @@ import sys
 #: Units in NEITHER table are compared as higher-is-better and the
 #: entry is annotated ``unit_assumed`` so a wrong guess is visible.
 _HIGHER = ("rounds/sec", "hit_rate", "% test acc", "accuracy", "acc")
-_LOWER = ("seconds", "ms/round", "s", "ms")
+_LOWER = ("seconds", "ms/round", "s", "ms", "MB/round")
 
 
 def extract_records(text: str) -> dict[str, dict]:
