@@ -1395,6 +1395,128 @@ def defense_sharded_records(mesh_sizes=(1, 4, 8), c=1000, iters=3):
     return records
 
 
+def async_bench_records(n_clients=10_000, fanins=(1, 2, 4),
+                        buffer_k=4, flush_every=8, horizon_s=20.0,
+                        seed=0):
+    """Async emit throughput vs synchronous FedAvg on ONE simulated
+    open-loop 10k-client world at aggregator fan-in {1, 2, 4}
+    (docs/FAULT_TOLERANCE.md "Async + tiered worlds"; ROADMAP item 1's
+    acceptance shape). The world model is the deterministic
+    discrete-event simulation in ``core/async_agg.py``; the per-fold
+    and per-emit aggregation costs it charges are MEASURED here on the
+    real ``AsyncBuffer`` fold / ``server_update`` emit code over an
+    mnist_lr-sized model, so the control-plane shape rides real
+    arithmetic. Records one ``emits/sec`` line per fan-in, the flat
+    sync baseline, and the headline scaling ratio (l_max / l_1) —
+    which is the number that must not regress: absolute virtual-time
+    rates move with the measured costs, the RATIO is the
+    architecture."""
+    import jax
+    import jax.numpy as jnp
+
+    from fedml_tpu.config import ModelConfig, TrainConfig, FedConfig
+    from fedml_tpu.core import async_agg as AA
+    from fedml_tpu.algorithms.fedavg import (
+        ServerState,
+        local_reducer,
+        make_server_optimizer,
+        server_update,
+    )
+    from fedml_tpu.models import create_model
+
+    model = create_model(ModelConfig(name="lr", num_classes=10,
+                                     input_shape=(28, 28, 1)))
+    variables = model.init(jax.random.key(0))
+    acfg = AA.AsyncConfig(buffer_k=buffer_k)
+    buf = AA.AsyncBuffer(acfg, variables)
+    delta = jax.tree.map(lambda x: jnp.full_like(x, 1e-3), variables)
+
+    def timed(fn, reps):
+        fn()  # warm (compile/dispatch)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn()
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / reps
+
+    def fold_once():
+        buf.fold(delta, 32.0, 0)
+        return buf.sum  # block on the accumulator, not the weight
+
+    fold_cost_s = timed(fold_once, reps=50)
+    fed = FedConfig()
+    opt = make_server_optimizer(fed.server_optimizer, fed.server_lr,
+                                fed.server_momentum)
+    state = ServerState(
+        variables=variables,
+        opt_state=opt.init(variables["params"]),
+        momentum=jax.tree.map(jnp.zeros_like, variables["params"]),
+        round=jnp.asarray(0, jnp.int32),
+    )
+    row = jax.tree.map(lambda x: x[None], variables)
+
+    def emit():
+        return server_update(
+            fed, TrainConfig(), 1, 32, state, row,
+            jnp.asarray([32.0]), jax.random.key(1), local_reducer(),
+        ).variables
+
+    emit_cost_s = timed(emit, reps=10)
+    kw = dict(n_clients=n_clients, buffer_k=buffer_k,
+              flush_every=flush_every, horizon_s=horizon_s, seed=seed,
+              fold_cost_s=fold_cost_s, emit_cost_s=emit_cost_s)
+    records = []
+    rates = {}
+    for leaves in fanins:
+        r = AA.simulate_open_loop(n_leaves=leaves, **kw)
+        rates[leaves] = r["emits_per_sec"]
+        records.append({
+            "metric": (
+                f"async_emits_per_sec_{n_clients // 1000}kc_mnist_lr"
+                f"_l{leaves}"
+            ),
+            "value": round(r["emits_per_sec"], 4),
+            "unit": "emits/sec",
+            "n_leaves": leaves,
+            "buffer_k": buffer_k,
+            "flush_every": flush_every,
+            "folds_per_sec": round(r["folds_per_sec"], 2),
+            "fold_cost_us": round(fold_cost_s * 1e6, 2),
+            "emit_cost_us": round(emit_cost_s * 1e6, 2),
+            "simulated": True,
+        })
+    sync = AA.simulate_open_loop(n_leaves=1, sync=True, **kw)
+    sync_hi = AA.simulate_open_loop(n_leaves=max(fanins), sync=True,
+                                    **kw)
+    records.append({
+        "metric": f"sync_rounds_per_sec_{n_clients // 1000}kc_mnist_lr",
+        "value": round(sync["rounds_per_sec"], 6),
+        "unit": "rounds/sec",
+        "n_leaves": 1,
+        # the saturation story: the barrier pins the sync rate to the
+        # straggler max, so fan-in buys it (nearly) nothing
+        "rounds_per_sec_at_max_fanin": round(
+            sync_hi["rounds_per_sec"], 6
+        ),
+        "simulated": True,
+    })
+    lo, hi = min(fanins), max(fanins)
+    records.append({
+        "metric": f"async_fanin_scaling_{n_clients // 1000}kc_mnist_lr",
+        "value": round(rates[hi] / max(rates[lo], 1e-12), 4),
+        "unit": "ratio",
+        "fanins": list(fanins),
+        "emits_per_sec": {str(k): round(v, 4)
+                          for k, v in rates.items()},
+        "sync_scaling": round(
+            sync_hi["rounds_per_sec"] / max(sync["rounds_per_sec"],
+                                            1e-12), 4
+        ),
+        "simulated": True,
+    })
+    return records
+
+
 def elastic_churn_record(rounds=24, num_clients=32, cohort=16, seed=0):
     """Compile-cache hit rate under a seeded membership-churn schedule
     (docs/FAULT_TOLERANCE.md "Elastic membership"): an elastic
@@ -1600,6 +1722,21 @@ def main():
                          "vs each delta codec, measured from the "
                          "transport.bytes_by_type counters over a "
                          "real loopback pair")
+    ap.add_argument("--async-bench", action="store_true",
+                    help="ONLY the async/tier stage: emit throughput "
+                         "of the buffered-async aggregator vs sync "
+                         "FedAvg on one simulated open-loop "
+                         "10k-client world at fan-in {1,2,4} leaves "
+                         "(real measured fold/emit costs; the "
+                         "tracked number is the SCALING RATIO)")
+    ap.add_argument("--fallback-only", action="store_true",
+                    help="emit ONLY the marked CPU-fallback record "
+                         "(+ one small labeled CPU measurement): the "
+                         "scripts/tpu_watchdog.sh integration — a "
+                         "watchdog-detected dead tunnel produces a "
+                         "BENCH artifact instead of nothing "
+                         "(docs/PERFORMANCE.md 'Bench "
+                         "trustworthiness')")
     args = ap.parse_args()
 
     # Fail FAST if the device backend cannot come up: a wedged TPU
@@ -1617,11 +1754,20 @@ def main():
 
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     probe_err = None
-    try:
-        subprocess.run(
-            [sys.executable, "-c", _PROBE_SRC],
-            timeout=300, capture_output=True, check=True,
+    if args.fallback_only:
+        # scripts/tpu_watchdog.sh already established the tunnel is
+        # dead — don't burn another 300 s probing it; go straight to
+        # the marked-fallback path so the round's artifact exists
+        probe_err = (
+            "tpu_watchdog reported a dead TPU tunnel "
+            "(--fallback-only)"
         )
+    try:
+        if probe_err is None:
+            subprocess.run(
+                [sys.executable, "-c", _PROBE_SRC],
+                timeout=300, capture_output=True, check=True,
+            )
     except subprocess.TimeoutExpired:
         probe_err = (
             "jax backend did not initialize within 300s — the TPU "
@@ -1709,6 +1855,10 @@ def main():
         return
     if args.elastic_bench:
         emit(staged("elastic", elastic_churn_record))
+        return
+    if args.async_bench:
+        for rec in staged("async", async_bench_records):
+            emit(rec)
         return
     if args.wire_bench:
         for rec in staged("wire", wire_bench_records):
@@ -1833,6 +1983,15 @@ def main():
     except Exception as err:
         print(f"[bench] defense m-sweep failed: {err}",
               file=sys.stderr, flush=True)
+    try:
+        # async/tier open-loop scaling (cheap, virtual-time): tracked
+        # by bench_diff from this PR on — the scaling RATIO is the
+        # regression surface, the per-fanin rates are diagnostics
+        for rec in staged("async", async_bench_records):
+            emit(rec)
+    except Exception as err:
+        print(f"[bench] async stage failed: {err}", file=sys.stderr,
+              flush=True)
     sim, _ = build_sim(model_name="resnet56")
     emit(staged(
         "rate.resnet56_std",

@@ -110,6 +110,15 @@ echo "== elastic smoke: mid-run admission + graceful LEAVE =="
 # (docs/FAULT_TOLERANCE.md "Elastic membership")
 JAX_PLATFORMS=cpu python scripts/elastic_smoke.py "$OUT/elastic"
 
+echo "== async smoke: root + 2 leaf aggregators + straggler over gRPC =="
+# an async (--async_buffer_k 1) tiered (--tier_spec root:2) gRPC world
+# — root, 2 leaf aggregators, 4 clients, one chaos-delayed straggler —
+# must converge, fold the straggler leaf's LATE partials with a
+# staleness weight instead of dropping them (async.stale_folds > 0),
+# and reduce strictly near the wire (tier.partial_sums > 0)
+# (docs/FAULT_TOLERANCE.md "Async + tiered worlds")
+JAX_PLATFORMS=cpu python scripts/async_smoke.py "$OUT/async"
+
 echo "== compress smoke: topk_int8 wire vs dense over gRPC =="
 # the same 1-server + 2-client gRPC world runs dense and under
 # --compress topk_int8: the per-type byte counters must show >=4x on

@@ -1,0 +1,886 @@
+"""Asynchronous + hierarchical aggregation actors.
+
+Two new server-side shapes over the same message-passing runtime as
+:mod:`fedml_tpu.algorithms.distributed_fedavg` (docs/FAULT_TOLERANCE.md
+"Async + tiered worlds"):
+
+- :class:`AsyncFedAvgServerActor` — the FedBuff-style buffered-async
+  server (ROADMAP item 1a): every arriving screened delta folds into a
+  staleness-weighted :class:`~fedml_tpu.core.async_agg.AsyncBuffer`
+  tagged with the model VERSION it trained against, a new model emits
+  every ``--async_buffer_k`` arrivals through the unchanged
+  ``server_update`` body, and the sender is re-synced INDIVIDUALLY the
+  moment its result lands — no round barrier, a slow client never
+  blocks a fast one (Server Averaging for FL, arxiv 2103.11619).
+- :class:`TierAggregatorActor` (leaf) + :class:`TierRootActor` /
+  :class:`AsyncTierRootActor` (root) — the multi-tier aggregator tree
+  (ROADMAP item 1b; the Smart-NIC partial-reduction shape, arxiv
+  2307.06561): each leaf terminates its clients' transports in its own
+  deployment world, runs decompress -> validate -> clip -> partial-sum
+  near the wire reusing the PR 7 codec and the receive-edge screens,
+  and forwards ONE typed ``[sum, n, count]`` partial per flush
+  upstream; the root folds one row per leaf through the same
+  ``server_update`` / ``DefensePipeline`` body, so the tree changes
+  WHERE reduction happens, not what is computed. Each tier runs its
+  own ``MembershipLedger``, ``LivenessMonitor``, and reputation scope
+  — a leaf's Byzantine client is quarantined AT ITS LEAF and never
+  pollutes a sibling leaf's (or the root's) reputation plane.
+
+Both modes ride the existing sealed wire frames, checkpoint their
+buffer/version state through ``RoundCheckpointer`` (a SIGKILLed async
+root resumes its buffer, not just its params), and are strictly
+opt-in: with ``--async_buffer_k 0`` and no ``--tier_spec`` the deploy
+path constructs the untouched :class:`FedAvgServerActor` — the
+synchronous world stays byte-identical (pinned in tests/test_async.py).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.config import ExperimentConfig
+from fedml_tpu.core import async_agg as AA
+from fedml_tpu.core import compress as CMP
+from fedml_tpu.core import telemetry
+from fedml_tpu.core import tier as TIER
+from fedml_tpu.core import tree as T
+from fedml_tpu.core import random as RND
+from fedml_tpu.core.manager import Manager
+from fedml_tpu.core.message import (
+    KEY_CLIENT_INDEX,
+    KEY_MODEL_PARAMS,
+    KEY_NUM_SAMPLES,
+    KEY_ROUND,
+    MSG_TYPE_C2S_RESULT,
+    MSG_TYPE_L2R_PARTIAL,
+    MSG_TYPE_S2C_SYNC_MODEL,
+    Message,
+)
+from fedml_tpu.algorithms.distributed_fedavg import (
+    FedAvgServerActor,
+    _result_is_finite,
+)
+from fedml_tpu.algorithms.fedavg import local_reducer, server_update
+
+
+def check_async_compat(cfg: ExperimentConfig) -> None:
+    """Surface contradictions at construction/parse time, before a
+    supervised server can crash-loop its restart budget away."""
+    acfg = AA.AsyncConfig.from_fed(cfg.fed)
+    if not acfg.enabled():
+        return
+    if cfg.fed.algorithm == "fednova":
+        raise ValueError(
+            "async_buffer_k is incompatible with fednova: the async "
+            "emit is ONE staleness-folded row, but tau-normalization "
+            "needs per-client step counts — run fednova synchronously"
+        )
+    if cfg.fed.shard_aggregation:
+        raise ValueError(
+            "async_buffer_k is incompatible with --shard_aggregation: "
+            "the async emit aggregates one folded row — there is no "
+            "client axis left to shard (the fan-out lives in the tier "
+            "tree instead, --tier_spec)"
+        )
+
+
+class AsyncFedAvgServerActor(FedAvgServerActor):
+    """Buffered-async rank-0 aggregator. Inherits the membership
+    ledger, liveness routing, reputation plane, compression screens,
+    and Byzantine defense body from :class:`FedAvgServerActor`;
+    replaces the round BARRIER with per-arrival folds + per-K
+    emissions. ``round_idx`` tracks the model VERSION (the emit
+    counter) so every inherited helper that reads it — membership
+    activation, WELCOME replay, summaries — keeps working."""
+
+    def __init__(self, *args, checkpointer=None, **kwargs):
+        # the base restore ties orbax steps to closed ROUNDS and
+        # round-checks the restored counter; async steps are FOLDS and
+        # the buffer rides the payload — so this subclass owns the
+        # whole checkpoint story (see _restore_async below)
+        super().__init__(*args, checkpointer=None, **kwargs)
+        self._acfg = AA.AsyncConfig.from_fed(self.cfg.fed)
+        if not self._acfg.enabled():
+            raise ValueError(
+                "AsyncFedAvgServerActor needs fed.async_buffer_k >= 1 "
+                "(with 0, construct the synchronous FedAvgServerActor)"
+            )
+        check_async_compat(self.cfg)
+        self._buffer = AA.AsyncBuffer(self._acfg, self.state.variables)
+        # model snapshots per still-foldable version: a dense result is
+        # a FULL variables tree, so its delta needs the exact model it
+        # trained against (compressed results and tier partials carry
+        # deltas and never consult the history)
+        self._history_depth = max(8, 2 * self._acfg.buffer_k)
+        self._history: dict[int, dict] = {}
+        # (rank -> folded versions) dedup: chaos dup / WELCOME replay
+        self._folded: dict[int, set[int]] = {}
+        # FedBuff concurrency control: a member whose result already
+        # went into the CURRENT version parks here (re-syncing it with
+        # the same model would only provoke the same deterministic
+        # result again); every emission drains the set
+        self._parked: set[int] = set()
+        self._folds = 0
+        # orbax save step: strictly monotonic and DISTINCT from the
+        # fold count — a forced emission must persist too, and saving
+        # twice at one fold count would be a silent orbax no-op
+        self._save_step = 0
+        self.restored_folds = 0
+        self._ckpt = checkpointer
+        if checkpointer is not None:
+            self._restore_async(checkpointer)
+
+    # -- checkpoint (docs/FAULT_TOLERANCE.md "Async + tiered worlds") ------
+
+    def _restore_async(self, ckpt) -> None:
+        """Composite restore with async semantics: orbax steps are
+        FOLD counts (monotonic across emissions), the ``"async"``
+        payload carries the buffer mid-accumulation, and
+        ``resumed_from`` reports the restored VERSION. A pre-async
+        checkpoint (no ``"async"`` key) restores params and starts the
+        buffer empty."""
+        from fedml_tpu.utils.checkpoint import from_savable
+
+        raw, start = ckpt.restore_raw()
+        if raw is None:
+            return
+        if not (isinstance(raw, dict) and "server" in raw):
+            raise ValueError(
+                "async server found a non-composite checkpoint in its "
+                "run dir — wrong run directory? (the async path always "
+                "writes {'server', ..., 'async'} composites)"
+            )
+        self.state = from_savable(self.state, raw["server"])
+        if "reputation" in raw:
+            self._reputation.load_arrays(raw["reputation"])
+        if "membership" in raw:
+            self._ledger.load_arrays(raw["membership"])
+        if "async" in raw:
+            self._buffer.load_arrays(raw["async"])
+            self.restored_folds = self._buffer.count
+        else:
+            self._buffer.version = int(self.state.round)
+            import warnings
+
+            warnings.warn(
+                "restored a pre-async checkpoint (no buffer payload); "
+                "the staleness buffer starts empty",
+                stacklevel=2,
+            )
+        self._folds = 0  # cadence restarts; the SAVE step must not
+        self._save_step = start
+        self.round_idx = self._buffer.version
+        self.resumed_from = self._buffer.version
+        telemetry.METRICS.inc("recovery.resumes")
+        telemetry.METRICS.gauge("recovery.resumed_from_round",
+                                self.resumed_from)
+        telemetry.METRICS.gauge("recovery.async_buffer_restored",
+                                self.restored_folds)
+        telemetry.RECORDER.record(
+            "resume", round=self.resumed_from, mode="async",
+            buffer_count=self.restored_folds,
+        )
+
+    def _save_checkpoint(self) -> None:
+        if self._ckpt is None:
+            return
+        self._save_step += 1
+        self._ckpt.save(self._save_step, {
+            "server": self.state,
+            "reputation": self._reputation.state_arrays(),
+            "membership": self._ledger.state_arrays(),
+            "async": self._buffer.state_arrays(),
+        })
+        telemetry.METRICS.inc("recovery.checkpoints")
+        telemetry.RECORDER.record("checkpoint", round=self.round_idx,
+                                  folds=self._folds)
+        telemetry.flush_metrics()
+
+    # -- version bookkeeping -----------------------------------------------
+
+    def _assignment(self, rank: int) -> int:
+        """Async cohort assignment: every member trains its
+        ledger-stable client id every version (there is no sampled
+        round cohort to deal out — the open loop IS the cohort)."""
+        return self._ledger.client_id(rank)
+
+    def _stash_sync_locked(self, host_vars) -> None:
+        """Refresh the WELCOME-replay snapshot + dense-delta history
+        for the current version. Caller holds ``self._lock``."""
+        members = self._member_workers()
+        cohort = np.asarray(
+            [self._assignment(r) for r in members]
+            or [0], np.int32,
+        )
+        slots = {r: i for i, r in enumerate(members)}
+        self._round_sync = (self.round_idx, host_vars, cohort, slots)
+        self._history[self.round_idx] = host_vars
+        floor = self.round_idx - self._history_depth
+        for v in [v for v in self._history if v < floor]:
+            del self._history[v]
+        for r, seen in self._folded.items():
+            self._folded[r] = {v for v in seen if v >= floor}
+
+    def start_round(self) -> None:
+        """Kick off (or resume) the open loop: broadcast the current
+        version to every live member. Called once at the readiness
+        barrier — afterward the loop is arrival-driven (per-sender
+        resyncs), never re-broadcast."""
+        if self.round_idx >= self.cfg.fed.num_rounds:
+            self.done.set()
+            self.finish_all()
+            return
+        self._round_t0 = time.monotonic()
+        host_vars = jax.tree.map(np.asarray, self.variables)
+        with self._lock:
+            self._stash_sync_locked(host_vars)
+            ranks = self._live_workers()
+            # --round_deadline becomes a PROGRESS deadline in the
+            # async world: with heartbeats off there is no other
+            # backstop, and an accepted-but-inert flag would revive
+            # the crashed-client-wedges-the-world hang PR 1 removed
+            self._arm_progress_deadline_locked()
+        self.broadcast(
+            MSG_TYPE_S2C_SYNC_MODEL,
+            lambda r: {
+                KEY_MODEL_PARAMS: host_vars,
+                KEY_CLIENT_INDEX: self._assignment(r),
+                KEY_ROUND: self.round_idx,
+            },
+            ranks=ranks,
+            on_send_error=self._on_sync_send_failed,
+        )
+
+    def _resync(self, rank: int) -> None:
+        """The async contract's core move: the instant a member's
+        result is handled, IT ALONE is synced with the current model —
+        fast clients loop fast, slow clients loop slow, nobody
+        waits."""
+        with self._lock:
+            if self.done.is_set() or self.failure is not None:
+                return
+            sync = self._round_sync
+        if sync is None:
+            return
+        version, host_vars = sync[0], sync[1]
+        try:
+            self.send_message(Message(
+                MSG_TYPE_S2C_SYNC_MODEL, self.rank, rank,
+                {
+                    KEY_MODEL_PARAMS: host_vars,
+                    KEY_CLIENT_INDEX: self._assignment(rank),
+                    KEY_ROUND: version,
+                },
+            ))
+        except Exception:
+            self.on_peer_dead(rank)
+
+    def on_peer_join(self, rank: int) -> str | None:
+        verdict = super().on_peer_join(rank)
+        if verdict == "admitted":
+            # no next-round broadcast will ever cover a mid-run
+            # admission — serve it the current version immediately
+            # (there is no in-flight quorum an admission could skew)
+            self._resync(rank)
+        return verdict
+
+    # -- the arrival path --------------------------------------------------
+
+    def _handle_result(self, msg: Message) -> None:
+        n_raw = msg.get(KEY_NUM_SAMPLES)
+        msg_round = msg.get(KEY_ROUND)
+        sender = msg.sender
+        with self._lock:
+            if self.done.is_set() or self.failure is not None:
+                return
+            if sender in self.dead_peers:
+                return
+            if self._ledger.status(sender) == "evicted":
+                return
+            if msg_round is None:
+                return  # async results are always version-tagged
+            v = int(msg_round)
+            if v in self._folded.get(sender, ()):
+                telemetry.METRICS.inc("round.duplicate_results")
+                return
+            version = self.round_idx
+        lag = version - v
+        if lag < 0:
+            # a version from the future: config skew or a corrupted
+            # tag — unusable either way
+            telemetry.METRICS.inc("async.too_stale")
+            self._resync(sender)
+            return
+        n_k = float(n_raw) if n_raw is not None else float("nan")
+        delta = None
+        if self._cspec.enabled():
+            payload = self._screen_compressed(msg)
+            if payload is not None and math.isfinite(n_k):
+                # compressed payloads ARE deltas: decompression needs
+                # only the shapes, never the historical model
+                delta = CMP.decompress_tree(
+                    self._cspec, payload, self.state.variables
+                )
+            elif payload is not None:
+                telemetry.METRICS.inc("robust.nonfinite_rejected")
+        else:
+            params = msg.get(KEY_MODEL_PARAMS)
+            if params is not None and _result_is_finite(params, n_k):
+                base = self._history.get(v)
+                if base is None:
+                    # the model it trained against aged out of the
+                    # history ring: the delta is unrecoverable —
+                    # folded it would be garbage, so count + drop
+                    # (the resync below puts the client back to work)
+                    telemetry.METRICS.inc("async.too_stale")
+                else:
+                    delta = jax.tree.map(
+                        lambda p, b: jnp.asarray(p) - jnp.asarray(b),
+                        params, base,
+                    )
+            elif params is not None:
+                telemetry.METRICS.inc("robust.nonfinite_rejected")
+                telemetry.RECORDER.record(
+                    "nonfinite_rejected", peer=sender, round=v,
+                )
+        if delta is not None:
+            self._fold(sender, delta, n_k, v, lag)
+        self._after_result(sender, v)
+
+    def _fold(self, sender: int, delta, n_k: float, v: int,
+              lag: int) -> None:
+        """Screened delta -> defense-preprocess -> staleness-weighted
+        fold -> maybe emit. The fold is the only stateful step and
+        runs under the server lock (arrivals are serialized by the
+        dispatch thread anyway; the lock also fences LEAVE/evict)."""
+        m = telemetry.METRICS
+        if self._reputation.is_quarantined(sender):
+            # quarantined ranks stay served (they can earn back in a
+            # sync world); in the async world their folds are simply
+            # excluded — the ban rides the restored checkpoint
+            m.inc("defense.excluded")
+            return
+        # per-arrival defense preprocessing (clip) — the "(decompressed,
+        # screened, defense-preprocessed) delta" of the contract; the
+        # emit re-applies postprocess/noise on the aggregate
+        clipped = jax.tree.map(
+            lambda x: x[0],
+            self._pipeline.preprocess(
+                jax.tree.map(lambda x: x[None], delta)
+            ),
+        )
+        with self._lock:
+            if self.done.is_set() or self.failure is not None:
+                return
+            self._folded.setdefault(sender, set()).add(v)
+            w = self._buffer.fold(clipped, n_k, lag)
+            self._folds += 1
+            folds = self._folds
+            if m.enabled:
+                m.inc("async.folds")
+                m.gauge("async.buffer_depth", self._buffer.count)
+                m.gauge("async.staleness", lag)
+                m.gauge("async.staleness_weight", w)
+                if lag > 0:
+                    m.inc("async.stale_folds")
+        if not self._maybe_emit() and (
+                folds % self.checkpoint_every == 0):
+            self._save_checkpoint()
+
+    def _arm_progress_deadline_locked(self) -> None:
+        """(Re-)arm the async progress watchdog — the round deadline's
+        meaning here: every configured window must see an EMISSION.
+        Caller holds ``self._lock``. Generation-stamped exactly like
+        the base's round timers (cancel() cannot stop a timer whose
+        callback is already blocked on the lock)."""
+        if self.round_policy.round_deadline_s is None:
+            return
+        if self._deadline_timer is not None:
+            self._deadline_timer.cancel()
+        self._deadline_gen += 1
+        t = threading.Timer(
+            self.round_policy.round_deadline_s,
+            self._on_progress_deadline,
+            args=(self._deadline_gen,),
+        )
+        t.daemon = True
+        self._deadline_timer = t
+        t.start()
+
+    def _on_progress_deadline(self, gen: int) -> None:
+        """No emission for a whole deadline window: force out whatever
+        the buffer holds (progress beats a wedged world), or — with an
+        empty buffer — abort loudly: whoever was supposed to fill it
+        is gone, and without heartbeats this watchdog is the only
+        thing standing between the run and an infinite hang."""
+        with self._lock:
+            if (self.done.is_set() or self.failure is not None
+                    or gen != self._deadline_gen):
+                return
+            pending = self._buffer.count
+            if not pending:
+                self.failure = (
+                    f"no emission within the "
+                    f"{self.round_policy.round_deadline_s}s progress "
+                    f"deadline at version {self.round_idx} with an "
+                    f"empty buffer (members "
+                    f"{self._member_workers()}, dead peers "
+                    f"{sorted(self.dead_peers)}, parked "
+                    f"{sorted(self._parked)})"
+                )
+        if pending:
+            telemetry.METRICS.inc("async.forced_emits")
+            self._maybe_emit(force=True)  # re-arms the watchdog itself
+            return
+        telemetry.METRICS.inc("round.quorum_lost_aborts")
+        telemetry.flight_dump(
+            "quorum_lost", detail=self.failure, round=self.round_idx,
+        )
+        self.finish_all()
+
+    def _maybe_emit(self, force: bool = False) -> bool:
+        """Emit when the buffer holds K folds (``force``: any folds —
+        the stalled-world safety valve), then run the post-emit
+        protocol: checkpoint, completion check, and the re-sync of
+        every parked member with the NEW version."""
+        with self._lock:
+            if self.done.is_set() or self.failure is not None:
+                return False
+            if not (self._buffer.ready()
+                    or (force and self._buffer.count > 0)):
+                return False
+            self._emit_locked()
+            self._arm_progress_deadline_locked()
+            parked = sorted(self._parked)
+            self._parked.clear()
+        self._save_checkpoint()
+        if self.on_round_done is not None:
+            self.on_round_done(self.round_idx, {"async": True})
+        if self.round_idx >= self.cfg.fed.num_rounds:
+            self.done.set()
+            self.finish_all()
+            return True
+        for r in parked:
+            self._resync(r)
+        return True
+
+    def _after_result(self, sender: int, v: int) -> None:
+        """Route the sender after its result was handled: a member
+        whose contribution (or unusable attempt) was for the CURRENT
+        version parks until the next emission — its model has not
+        changed, so putting it back to work would only reproduce the
+        same bytes; a member behind the current version goes straight
+        back to work on the new model. This is what 'a slow client
+        never blocks a fast one' costs: fast movers fill the buffer,
+        parked movers wait out exactly one emission."""
+        with self._lock:
+            if self.done.is_set() or self.failure is not None:
+                return
+            park = v >= self.round_idx
+            if park:
+                self._parked.add(sender)
+        if park:
+            self._recover_if_stalled()
+        else:
+            self._resync(sender)
+
+    def _recover_if_stalled(self) -> None:
+        """Liveness valve: when EVERY live member is parked, no future
+        arrival can complete the buffer — emit what is pending (a
+        short emission beats a wedged world; counted
+        ``async.forced_emits``), or abort loudly when even the buffer
+        is empty (every member's current-version result was screened
+        out; a deterministic retry cannot fix that)."""
+        with self._lock:
+            if self.done.is_set() or self.failure is not None:
+                return
+            if self._round_sync is None:
+                return  # pre-kickoff arrivals park until the barrier
+            live = self._live_workers()
+            if not live or any(r not in self._parked for r in live):
+                return
+            pending = self._buffer.count
+            if not pending:
+                self.failure = (
+                    f"async world stalled at version {self.round_idx}: "
+                    f"every live member ({live}) is parked and the "
+                    f"buffer is empty (all current-version results "
+                    f"were screened out)"
+                )
+        if pending:
+            telemetry.METRICS.inc("async.forced_emits")
+            self._maybe_emit(force=True)
+            return
+        telemetry.METRICS.inc("round.quorum_lost_aborts")
+        telemetry.flight_dump(
+            "async_stalled", detail=self.failure, round=self.round_idx,
+        )
+        self.finish_all()
+
+    def _emit_locked(self) -> None:
+        """Drain the buffer into one ``server_update`` step (the same
+        body every synchronous path runs, so the server rule cannot
+        drift) and advance the version. Caller holds ``self._lock``."""
+        mean_delta, mass = self._buffer.emit()
+        row = jax.tree.map(
+            lambda g, d: (g + d.astype(g.dtype))[None],
+            self.state.variables, mean_delta,
+        )
+        rkey = RND.round_key(self.root_key, self.state.round)
+        self.state = server_update(
+            self.cfg.fed,
+            self.cfg.train,
+            self.steps_per_epoch,
+            self.batch_size,
+            self.state,
+            row,
+            jnp.asarray([mass]),
+            rkey,
+            local_reducer(),
+        )
+        self.round_idx = self._buffer.version
+        telemetry.METRICS.inc("async.emits")
+        telemetry.RECORDER.record(
+            "async_emit", version=self.round_idx, mass=float(mass),
+        )
+        self._stash_sync_locked(
+            jax.tree.map(np.asarray, self.state.variables)
+        )
+
+    # -- inherited-protocol adjustments ------------------------------------
+
+    def _maybe_close_round(self, deadline_fired: bool,
+                           deadline_round=None, deadline_gen=None
+                           ) -> None:
+        """There is no round to close — this inherited entry (LEAVE /
+        evict / dead-peer) only has to keep the loudness contract: a
+        world with NO live member left can never emit again, so abort
+        instead of idling forever."""
+        with self._lock:
+            if self.done.is_set() or self.failure is not None:
+                return
+            if self._round_sync is None:
+                return  # pre-kickoff departure replay
+            alive = bool(self._live_workers())
+            if not alive:
+                self.failure = (
+                    f"no live workers left at version {self.round_idx} "
+                    f"({len(self._member_workers())} members, dead "
+                    f"peers {sorted(self.dead_peers)})"
+                )
+        if alive:
+            # the departed/dead member may have been the only UNPARKED
+            # one — re-evaluate the stall valve over the survivors
+            self._recover_if_stalled()
+            return
+        telemetry.METRICS.inc("round.quorum_lost_aborts")
+        telemetry.flight_dump(
+            "quorum_lost", detail=self.failure, round=self.round_idx,
+        )
+        self.finish_all()
+
+
+# ---------------------------------------------------------------------------
+# tier actors
+# ---------------------------------------------------------------------------
+
+
+class TierAggregatorActor(FedAvgServerActor):
+    """LEAF aggregator: rank 0 of its own leaf deployment world
+    (terminating its clients' transports) and a member rank of the
+    root world (the ``uplink``). Inherits the WHOLE server-side client
+    protocol — readiness barrier, ledger, liveness, straggler rounds,
+    receive-edge screens, compressed-round decompression, per-leaf
+    reputation/quarantine — and replaces the aggregation tail: a
+    closed round becomes one clipped partial ``[sum, n, count]``
+    forwarded upstream instead of a local ``server_update``. The model
+    it serves its clients is whatever the LAST root sync carried; the
+    root alone owns optimizer state and versions."""
+
+    def __init__(self, size: int, transport, uplink: Manager, model,
+                 cfg: ExperimentConfig, *, client_base: int = 0,
+                 **kwargs):
+        kwargs.pop("checkpointer", None)  # the ROOT owns durability
+        super().__init__(size, transport, model, cfg,
+                         checkpointer=None, **kwargs)
+        self._uplink = uplink
+        self._client_base = int(client_base)
+        self.partials_sent = 0
+        self.root_finished = threading.Event()
+        # clip near the wire, once per client row (jitted per cohort
+        # count — leaf cohorts are small and churn via the quorum
+        # machinery, so the cache stays tiny)
+        self._partial_fn = jax.jit(self._partial_sum)
+        uplink.register_message_receive_handler(
+            MSG_TYPE_S2C_SYNC_MODEL, self.on_root_sync
+        )
+        from fedml_tpu.core.message import (
+            MSG_TYPE_FINISH,
+            MSG_TYPE_S2C_WELCOME,
+        )
+
+        uplink.register_message_receive_handler(
+            MSG_TYPE_S2C_WELCOME, self.on_root_sync
+        )
+        uplink.register_message_receive_handler(
+            MSG_TYPE_FINISH, self.on_root_finish
+        )
+
+    def _sample(self) -> np.ndarray:
+        """A leaf's clients train a contiguous block of global client
+        ids anchored at ``client_base`` — sibling leaves cover
+        disjoint shards by construction (core/tier.py)."""
+        n = max(1, len(self._member_workers()))
+        return (self._client_base + np.arange(n)) % self.num_clients
+
+    # -- root-facing protocol ----------------------------------------------
+
+    def on_root_sync(self, msg: Message) -> None:
+        """A root sync (or WELCOME replay) opens leaf round VERSION:
+        adopt the model, then run the inherited round machinery over
+        this leaf's clients. A duplicate sync for the version already
+        in flight only refreshes nothing (clients are mid-update); a
+        sync for an already-flushed version RE-RUNS it — the root only
+        re-serves a version when its partial was lost with a dead
+        incarnation."""
+        version = int(msg.get(KEY_ROUND))
+        variables = jax.tree.map(jnp.asarray,
+                                 msg.get(KEY_MODEL_PARAMS))
+        with self._lock:
+            if self.done.is_set() or self.failure is not None:
+                return
+            sync = self._round_sync
+            if (sync is not None and sync[0] == version
+                    and self.round_idx == version):
+                return  # duplicate of the in-flight version
+            # adopt the root's model as this leaf's serving state so
+            # every inherited consumer — the ``variables`` property,
+            # compressed-round decompression, the anomaly scorer's
+            # global reference — reads the tier model
+            self.state = self.state._replace(variables=variables)
+            self.round_idx = version
+        self.start_round()
+
+    def on_root_finish(self, msg: Message) -> None:
+        self.root_finished.set()
+        self.done.set()
+        self.finish_all()  # FINISH this leaf's clients, stop downlink
+        self._uplink.finish()
+
+    # -- aggregation tail --------------------------------------------------
+
+    @staticmethod
+    def _partial_sum(stacked_deltas, weights):
+        return jax.tree.map(
+            lambda d: jnp.tensordot(
+                weights.astype(d.dtype), d, axes=1
+            ),
+            stacked_deltas,
+        )
+
+    def _close_round(self, results, closed_idx, n_live=None,
+                     dead=None) -> None:
+        """Decompress -> score/exclude (per-LEAF reputation) -> clip
+        -> partial-sum -> one frame upstream. No local server_update,
+        no checkpoint (the root owns both), no next round (the next
+        root sync opens it)."""
+        tr = telemetry.TRACER
+        if tr is not None:
+            tr.log_round_end(closed_idx)
+        m = telemetry.METRICS
+        stacked_all = None
+        if self._cspec.enabled() and results:
+            stacked_all = self._decompress_results(results)
+        included, stacked = self._score_and_exclude(
+            results, closed_idx, stacked_all
+        )
+        if stacked is None:
+            if stacked_all is not None:
+                ranks = sorted(results)
+                keep = jnp.asarray(
+                    [ranks.index(r) for r in included], jnp.int32
+                )
+                stacked = jax.tree.map(lambda x: x[keep], stacked_all)
+            else:
+                stacked = T.tree_stack(
+                    [results[r][0] for r in included]
+                )
+        weights = jnp.asarray(
+            [results[r][1] for r in included], jnp.float32
+        )
+        gvars = self.variables
+        deltas = jax.tree.map(
+            lambda s, g: jnp.asarray(s) - g[None], stacked, gvars
+        )
+        clipped = self._pipeline.preprocess(deltas)
+        psum = self._partial_fn(clipped, weights)
+        n_total = float(weights.sum())
+        payload = TIER.build_partial(psum, n_total, len(included))
+        nbytes = sum(
+            a.nbytes for a in jax.tree.leaves(payload[TIER.KEY_TIER_SUM])
+        )
+        self.partials_sent += 1
+        if m.enabled:
+            m.inc("tier.partial_sums")
+            m.inc("tier.leaf_rounds")
+            m.inc("tier.forward_bytes", nbytes)
+            m.gauge("round.results", len(results))
+        telemetry.RECORDER.record(
+            "tier_partial", version=closed_idx, clients=len(included),
+            n=n_total,
+        )
+        try:
+            self._uplink.send_message(Message(
+                MSG_TYPE_L2R_PARTIAL, self._uplink.rank, 0,
+                {
+                    **payload,
+                    KEY_NUM_SAMPLES: n_total,
+                    KEY_ROUND: closed_idx,
+                },
+            ))
+        except Exception:
+            # root unreachable: the uplink liveness watchdog owns the
+            # verdict; this version's partial is simply lost and the
+            # root's straggler machinery absorbs it
+            telemetry.METRICS.inc("tier.partial_send_failures")
+
+
+class _PartialRootMixin:
+    """Shared root-side partial handling: receive-edge validation +
+    conversion of ``[sum, n, count]`` into the delta the fold/round
+    body consumes. Mixed into both root flavors so the sync and async
+    trees cannot drift on the wire contract."""
+
+    def _init_partial_plane(self, tier_spec: TIER.TierSpec) -> None:
+        self.tier_spec = tier_spec
+        # partials ride the leaf->root edge DENSE by design (one frame
+        # per flush amortizes the wire); the client->leaf codec is the
+        # leaves' business — neutralize the inherited compressed-result
+        # plane so the C2S_RESULT screens never misfire at the root
+        self._cspec = CMP.CompressionSpec()
+        self._payload_template = None
+        self._decomp_cache = None
+        self.register_message_receive_handler(
+            MSG_TYPE_L2R_PARTIAL, self._handle_partial
+        )
+        # a stray client wired straight at the root is a topology
+        # error; its dense result must not silently join the leaves'
+        # partials
+        self.register_message_receive_handler(
+            MSG_TYPE_C2S_RESULT, self._reject_direct_result
+        )
+
+    def _reject_direct_result(self, msg: Message) -> None:
+        telemetry.METRICS.inc("tier.direct_results_rejected")
+        telemetry.RECORDER.record(
+            "tier_direct_result_rejected", peer=msg.sender,
+            round=msg.get(KEY_ROUND),
+        )
+
+    def _screen_partial(self, msg: Message):
+        """Validate one partial at the receive edge; returns
+        ``(mean_delta_tree, n_total)`` or None (counted + dropped)."""
+        n_raw = msg.get(KEY_NUM_SAMPLES)
+        n_total = float(n_raw) if n_raw is not None else float("nan")
+        err = TIER.validate_partial(self.state.variables, msg.payload,
+                                    n_total)
+        if err is not None:
+            telemetry.METRICS.inc("tier.partial_rejected")
+            telemetry.RECORDER.record(
+                "tier_partial_rejected", peer=msg.sender,
+                round=msg.get(KEY_ROUND), detail=err,
+            )
+            return None
+        inv = 1.0 / n_total
+        mean_delta = jax.tree.map(
+            lambda s: np.asarray(s) * inv, msg.get(TIER.KEY_TIER_SUM)
+        )
+        telemetry.METRICS.inc("tier.partial_sums")
+        return mean_delta, n_total
+
+
+class TierRootActor(_PartialRootMixin, FedAvgServerActor):
+    """Synchronous tier root: the unchanged round machinery
+    (quorum/deadline/defense/reputation/checkpoint) where each
+    "worker" is a LEAF and each booked result is its partial turned
+    into one weighted row ``global + sum/n``. The weighted mean over
+    leaf rows reproduces the flat world's weighted mean over all
+    clients exactly (core/tier.py); the defense rule and the
+    reputation plane operate at leaf granularity — the root's
+    per-tier scope."""
+
+    def __init__(self, *args, tier_spec: TIER.TierSpec, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._init_partial_plane(tier_spec)
+
+    def _handle_partial(self, msg: Message) -> None:
+        with self._lock:
+            if self._discard_locked(msg):
+                return
+        screened = self._screen_partial(msg)
+        if screened is None:
+            return
+        mean_delta, n_total = screened
+        with self._lock:
+            if self._discard_locked(msg):
+                return
+            sync = self._round_sync
+            if sync is None or sync[0] != self.round_idx:
+                return
+            host_vars = sync[1]
+            # one row per leaf against the ROUND's model snapshot: the
+            # inherited close recovers exactly sum/n as this leaf's
+            # delta
+            row = jax.tree.map(
+                lambda g, d: g + d.astype(g.dtype), host_vars,
+                mean_delta,
+            )
+            self._results[msg.sender] = (row, n_total)
+        self._maybe_close_round(deadline_fired=False)
+
+
+class AsyncTierRootActor(_PartialRootMixin, AsyncFedAvgServerActor):
+    """Asynchronous tier root: leaf partials fold into the staleness
+    buffer the moment they land (a partial CARRIES its delta, so even
+    a partial older than the history ring stays foldable), the leaf is
+    re-synced individually, and the model emits every K partials — the
+    fully barrier-free tree of ROADMAP item 1."""
+
+    def __init__(self, *args, tier_spec: TIER.TierSpec, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._init_partial_plane(tier_spec)
+
+    def _handle_partial(self, msg: Message) -> None:
+        sender = msg.sender
+        msg_round = msg.get(KEY_ROUND)
+        with self._lock:
+            if self.done.is_set() or self.failure is not None:
+                return
+            if sender in self.dead_peers:
+                return
+            if self._ledger.status(sender) == "evicted":
+                return
+            if msg_round is None:
+                return
+            v = int(msg_round)
+            if v in self._folded.get(sender, ()):
+                telemetry.METRICS.inc("round.duplicate_results")
+                return
+            version = self.round_idx
+        lag = version - v
+        if lag < 0:
+            telemetry.METRICS.inc("async.too_stale")
+            self._resync(sender)
+            return
+        screened = self._screen_partial(msg)
+        if screened is not None:
+            mean_delta, n_total = screened
+            delta = jax.tree.map(jnp.asarray, mean_delta)
+            self._fold(sender, delta, n_total, v, lag)
+        self._after_result(sender, v)

@@ -147,6 +147,18 @@ class FedConfig:
     # replicated aggregation path stays byte-identical. (The sims have
     # their own sharded runtime, parallel/client_parallel.py.)
     shard_aggregation: bool = False
+    # asynchronous (FedBuff-style) aggregation (core/async_agg.py,
+    # docs/FAULT_TOLERANCE.md "Async + tiered worlds"): the deploy
+    # server folds each arriving screened delta into a
+    # staleness-weighted buffer and emits a new model every K
+    # arrivals — no round barrier; clients are re-synced individually
+    # the moment their result lands. 0 (default) keeps the synchronous
+    # round machinery byte-identical.
+    async_buffer_k: int = 0
+    # staleness discount for results that trained against an older
+    # model version: "poly" = (1+lag)^-alpha, "const" = full weight
+    staleness_fn: str = "poly"
+    staleness_alpha: float = 0.5
     # performance observability (core/perf.py, docs/OBSERVABILITY.md
     # "Performance observability"): capture jax.profiler windows around
     # the first K compiled rounds and parse each into a device-time

@@ -70,6 +70,14 @@ MSG_TYPE_S2C_WELCOME = 9
 # ranks beyond the launch world_size (the ledger assigns them a stable
 # client id and they enter the cohort at the next round boundary).
 MSG_TYPE_C2S_LEAVE = 10
+# Multi-tier aggregation (core/tier.py, docs/FAULT_TOLERANCE.md "Async +
+# tiered worlds"): a LEAF aggregator forwards one partial reduction
+# ``[sum, n, count]`` upstream per flush — the root folds one row per
+# leaf instead of one per client, so the root's inbox scales with the
+# tree's fan-in, not the cohort. Rides the sealed wire frames like every
+# other message; validated at the root's receive edge
+# (tier.validate_partial).
+MSG_TYPE_L2R_PARTIAL = 11
 
 #: symbolic names for the per-type wire-byte counters
 #: (``transport.bytes_by_type.<name>``, docs/OBSERVABILITY.md): byte
@@ -87,6 +95,7 @@ MSG_TYPE_NAMES = {
     MSG_TYPE_C2S_JOIN: "c2s_join",
     MSG_TYPE_S2C_WELCOME: "s2c_welcome",
     MSG_TYPE_C2S_LEAVE: "c2s_leave",
+    MSG_TYPE_L2R_PARTIAL: "l2r_partial",
 }
 
 

@@ -1,0 +1,132 @@
+"""Multi-tier aggregator topology: spec parsing + the partial payload.
+
+The Smart-NIC FL serving line ("Performance Improvement of Federated
+Learning Server using Smart NIC", arxiv 2307.06561) shows where the
+single-aggregator bottleneck breaks: partial reduction CLOSE TO THE
+WIRE, before the root ever sees a delta. This module is the shared
+vocabulary of that shape for this runtime (docs/FAULT_TOLERANCE.md
+"Async + tiered worlds"):
+
+- :class:`TierSpec` — the topology flag (``--tier_spec root:L``): a
+  root aggregator serving ``L`` leaf aggregators, each leaf
+  terminating its own clients' transports in its own deployment world
+  (the leaf is rank 0 of a leaf world; the root world is
+  ``{0: root, 1..L: leaves}``). Each tier runs its OWN
+  ``MembershipLedger`` / ``LivenessMonitor`` / reputation scope, so
+  churn, crashes, and quarantine stay per-tier.
+- the **partial payload** — the one typed message a leaf forwards
+  upstream per flush: ``[sum, n, count]`` where ``sum`` is the
+  weighted delta sum over the leaf's included (screened,
+  defense-clipped, non-quarantined) client results, ``n`` the total
+  sample mass, and ``count`` how many client results it folds.
+  ``sum / n`` is the leaf's weighted-mean delta, so the root folding
+  one row per leaf with weight ``n`` through the unchanged
+  ``server_update`` body reproduces the flat world's weighted mean
+  over all clients — the tier tree changes WHERE reduction happens,
+  not what is computed.
+
+Partials are validated at the root's receive edge
+(:func:`validate_partial`) exactly like compressed payloads are at the
+server's (structure, shapes, dtypes, finiteness): a malformed or
+poisoned partial is counted and dropped, never folded.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import numpy as np
+
+#: payload keys for MSG_TYPE_L2R_PARTIAL (core/message.py)
+KEY_TIER_SUM = "tier_sum"
+KEY_TIER_COUNT = "tier_count"
+
+
+@dataclasses.dataclass(frozen=True)
+class TierSpec:
+    """Parsed ``--tier_spec``. Current grammar: ``root:<L>`` — one
+    root and ``L`` leaf aggregators (deeper trees are a composition of
+    this two-level unit and can reuse the same actor pair)."""
+
+    n_leaves: int
+
+    def __post_init__(self):
+        if self.n_leaves < 1:
+            raise ValueError(
+                f"tier_spec needs >= 1 leaf, got {self.n_leaves}"
+            )
+
+    @staticmethod
+    def parse(spec: str) -> "TierSpec":
+        head, sep, leaves = spec.partition(":")
+        if head != "root" or not sep or not leaves.isdigit():
+            raise ValueError(
+                f"--tier_spec expects 'root:<n_leaves>' (e.g. root:2), "
+                f"got {spec!r}"
+            )
+        return TierSpec(n_leaves=int(leaves))
+
+    @property
+    def root_world_size(self) -> int:
+        """The root deployment world: rank 0 = root, ranks 1..L =
+        leaf aggregators."""
+        return self.n_leaves + 1
+
+    def leaf_ranks(self) -> list[int]:
+        return list(range(1, self.n_leaves + 1))
+
+    def client_base(self, leaf_rank: int, leaf_clients: int) -> int:
+        """Default global-client-id base for a leaf's slot 0 when the
+        operator does not pass ``--tier_client_base`` explicitly:
+        equal-size leaves get contiguous id blocks, so two sibling
+        leaves never train the same seeded shard."""
+        if not (1 <= leaf_rank <= self.n_leaves):
+            raise ValueError(
+                f"leaf rank {leaf_rank} outside 1..{self.n_leaves}"
+            )
+        return (leaf_rank - 1) * leaf_clients
+
+
+def build_partial(sum_tree, n_total: float, count: int) -> dict:
+    """The leaf->root payload: host-converted sum tree + scalars.
+    Rides the sealed wire frames like every other message (the tensor
+    leaves take the native codec path)."""
+    return {
+        KEY_TIER_SUM: jax.tree.map(np.asarray, sum_tree),
+        KEY_TIER_COUNT: int(count),
+    }
+
+
+def validate_partial(template_vars, payload, n_total: float) -> str | None:
+    """Receive-edge screen for one partial: returns an error string
+    (counted ``tier.partial_rejected`` by the caller and dropped) or
+    None when the partial is foldable. Mirrors
+    ``compress.validate_payload``: structure against the model
+    template, per-leaf shape/dtype, finiteness everywhere — one NaN
+    leaf in a partial would poison the whole root aggregate."""
+    if not isinstance(payload, dict) or KEY_TIER_SUM not in payload:
+        return "missing tier_sum"
+    count = payload.get(KEY_TIER_COUNT)
+    if not isinstance(count, int) or count < 1:
+        return f"bad tier_count {count!r}"
+    if not (isinstance(n_total, (int, float)) and math.isfinite(n_total)
+            and n_total > 0):
+        return f"bad sample mass {n_total!r}"
+    try:
+        t_leaves, treedef = jax.tree.flatten(template_vars)
+        p_leaves, p_def = jax.tree.flatten(payload[KEY_TIER_SUM])
+    except Exception as err:  # exotic containers from a hostile peer
+        return f"unflattenable partial: {err}"
+    if treedef != p_def:
+        return "partial tree structure != model template"
+    for t, p in zip(t_leaves, p_leaves):
+        a = np.asarray(p)
+        if a.shape != np.shape(t):
+            return f"leaf shape {a.shape} != template {np.shape(t)}"
+        if not np.issubdtype(a.dtype, np.floating):
+            return f"non-float partial leaf dtype {a.dtype}"
+        if not np.all(np.isfinite(a)):
+            return "non-finite partial leaf"
+    return None

@@ -13,12 +13,80 @@ cooperative shutdown — no async thread kills (SURVEY.md §5.2).
 from __future__ import annotations
 
 import abc
+import collections
 import queue
 import threading
+import time
 from typing import Callable, Protocol
 
 from fedml_tpu.core import telemetry
-from fedml_tpu.core.message import Message, msg_type_name
+from fedml_tpu.core.message import (
+    MSG_TYPE_HEARTBEAT,
+    Message,
+    msg_type_name,
+)
+
+#: default bound on the dispatch inbox (docs/OBSERVABILITY.md
+#: ``manager.inbox_*``): under open-loop async arrivals an unbounded
+#: inbox can grow without bound while the depth gauge — only SAMPLED at
+#: deliver time — shows whatever the last arrival saw. The bound sheds
+#: the OLDEST HEARTBEAT first (liveness beacons are refreshed by ANY
+#: delivery and re-sent every interval, so one is always safe to drop);
+#: work messages (results, joins, partials) are NEVER shed — a full
+#: inbox of work degrades to the old unbounded behavior, visibly via
+#: the high-water-mark gauge.
+INBOX_CAPACITY = 4096
+
+
+class _BoundedInbox:
+    """Drop-in for the previous ``queue.Queue`` with the shed policy
+    above. ``get`` keeps the queue.Empty contract the dispatch loop
+    (and the TRPC handshake) rely on."""
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self._d: collections.deque = collections.deque()
+        self._cv = threading.Condition()
+        self.hwm = 0
+        self.shed = 0
+
+    def put(self, item: "Message | None") -> bool:
+        """Enqueue; returns True when an old heartbeat was shed to
+        make room (the caller counts it — this class stays
+        metrics-free so the lock never nests into telemetry)."""
+        shed = False
+        with self._cv:
+            if item is not None and len(self._d) >= self.capacity:
+                for i, m in enumerate(self._d):
+                    if (m is not None
+                            and m.msg_type == MSG_TYPE_HEARTBEAT):
+                        del self._d[i]
+                        self.shed += 1
+                        shed = True
+                        break
+            self._d.append(item)
+            if len(self._d) > self.hwm:
+                self.hwm = len(self._d)
+            self._cv.notify()
+        return shed
+
+    def get(self, timeout: float | None = None):
+        deadline = (
+            time.monotonic() + timeout if timeout is not None else None
+        )
+        with self._cv:
+            while not self._d:
+                if deadline is None:
+                    self._cv.wait()
+                else:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or not self._cv.wait(remaining):
+                        if not self._d:
+                            raise queue.Empty
+            return self._d.popleft()
+
+    def qsize(self) -> int:
+        return len(self._d)
 
 #: metric-name cache for the per-type byte counters: one small string
 #: per DISTINCT message type, so the enabled hot path still allocates
@@ -46,10 +114,10 @@ class BaseTransport(abc.ABC):
     # message is not trace-marked/gauged twice on its way to the actor
     _telemetry_deliver = True
 
-    def __init__(self, rank: int):
+    def __init__(self, rank: int, inbox_capacity: int = INBOX_CAPACITY):
         self.rank = rank
         self._observers: list[Observer] = []
-        self._inbox: queue.Queue[Message | None] = queue.Queue()
+        self._inbox = _BoundedInbox(inbox_capacity)
         self._stopped = threading.Event()
         # called at DELIVER time (receiver thread), before the message
         # waits in the inbox — liveness tracking must see arrivals even
@@ -59,6 +127,7 @@ class BaseTransport(abc.ABC):
         # precomputed so the enabled hot path allocates no per-message
         # strings (docs/OBSERVABILITY.md vocabulary)
         self._inbox_gauge = f"transport.inbox_depth.rank{rank}"
+        self._hwm_gauge = f"manager.inbox_hwm.rank{rank}"
 
     # -- to implement ------------------------------------------------------
     @abc.abstractmethod
@@ -121,7 +190,21 @@ class BaseTransport(abc.ABC):
                 m.gauge(self._inbox_gauge, self._inbox.qsize())
         for hook in self._deliver_hooks:
             hook(msg)
-        self._inbox.put(msg)
+        shed = self._inbox.put(msg)
+        if self._telemetry_deliver:
+            # backpressure surface (docs/OBSERVABILITY.md): the
+            # high-water-mark is cumulative truth about the worst
+            # backlog, where the sampled depth gauge above only shows
+            # what the last arrival happened to see. Per-rank name
+            # (gauges are last-write-wins) and gated exactly like the
+            # depth gauge — a chaos-wrapped inner inbox, drained by
+            # its pump thread, must not overwrite the real one's hwm.
+            # The shed counter is additive, so a shared name is fine.
+            m = telemetry.METRICS
+            if m.enabled:
+                m.gauge(self._hwm_gauge, self._inbox.hwm)
+                if shed:
+                    m.inc("manager.inbox_shed")
 
     def handle_receive_message(self, timeout: float | None = None) -> None:
         """Blocking dispatch loop (reference

@@ -131,6 +131,18 @@ class DeployConfig:
     # the restored ledger must mark them EVICTED, not LEFT — a LEFT
     # rank may JOIN back, a ban must survive the restart
     presumed_evicted: tuple[int, ...] = ()
+    # -- async + tiered aggregation (docs/FAULT_TOLERANCE.md "Async +
+    # tiered worlds"): the tier topology this world runs under
+    # (``root:<L>`` — one root, L leaf aggregators; None = flat).
+    # Roles "server" (the root) and "leaf" consume it; clients are
+    # topology-blind (they only ever talk to rank 0 of THEIR world).
+    tier_spec: str | None = None
+    # leaf rank only: the ROOT world's rank table (the leaf's own
+    # ``ip_config`` is its leaf world, where it is rank 0)
+    uplink_ip_config: dict[int, tuple[str, int]] | None = None
+    # leaf rank only: global client id of this leaf's slot 0 (None =
+    # the TierSpec default — contiguous equal-size blocks)
+    tier_client_base: int | None = None
     # -- telemetry (docs/OBSERVABILITY.md) ---------------------------------
     # directory for THIS rank's artifacts: trace_rank<r>.json span dump,
     # metrics_rank<r>.json snapshot, flight_rank<r>_*.json crash rings;
@@ -504,6 +516,104 @@ def _write_final(cfg: ExperimentConfig, tag: str, tree) -> str:
     return path
 
 
+def _run_tier_leaf_rank(cfg: ExperimentConfig, dep: DeployConfig) -> dict:
+    """Run ONE leaf aggregator (docs/FAULT_TOLERANCE.md "Async +
+    tiered worlds"): rank 0 of its own leaf world toward its clients
+    (``--ip_config``), member rank ``dep.rank`` of the root world
+    toward the root (``--uplink_ip_config``). The leaf waits for its
+    OWN clients' readiness barrier first, then announces JOIN upstream
+    — so the root's barrier completes exactly when every leaf's
+    subtree is servable."""
+    from fedml_tpu.algorithms.async_actors import TierAggregatorActor
+    from fedml_tpu.algorithms.distributed_fedavg import (
+        QuorumLostError,
+        RoundPolicy,
+    )
+    from fedml_tpu.core.reputation import QuarantinePolicy
+    from fedml_tpu.core.tier import TierSpec
+    from fedml_tpu.data.loaders import load_dataset
+    from fedml_tpu.models import create_model
+
+    tier = TierSpec.parse(dep.tier_spec)
+    data = load_dataset(cfg.data)
+    model = create_model(cfg.model)
+    # downlink: this leaf IS rank 0 of its leaf world. uplink: member
+    # rank of the root world — chaos flags stay on the client-facing
+    # edge only (a faulted uplink would punish every client at once).
+    downlink = _make_transport(dataclasses.replace(dep, rank=0))
+    uplink_t = _make_transport(dataclasses.replace(
+        dep, ip_config=dep.uplink_ip_config, fault=None,
+    ))
+    uplink = Manager(dep.rank, tier.root_world_size, uplink_t)
+    base = (
+        dep.tier_client_base
+        if dep.tier_client_base is not None
+        else tier.client_base(dep.rank, dep.world_size - 1)
+    )
+    leaf = TierAggregatorActor(
+        dep.world_size, downlink, uplink, model, cfg,
+        client_base=base,
+        num_clients=cfg.data.num_clients, data=data,
+        round_policy=RoundPolicy(
+            quorum_fraction=dep.quorum_fraction,
+            round_deadline_s=dep.round_deadline_s,
+            recovery_extensions=dep.recovery_extensions,
+        ),
+        quarantine=QuarantinePolicy(
+            threshold=dep.quarantine_threshold,
+            decay=dep.quarantine_decay,
+            evict_after=dep.quarantine_evict_after,
+        ),
+    )
+    up_state: dict = {"got": None, "failures": []}
+
+    def kickoff() -> None:
+        # this leaf's subtree is ready: surface upstream. The announce
+        # helper re-sends JOIN until the root answers and then arms
+        # the uplink liveness watchdog — a dead root stops the uplink,
+        # and the bridge below stops the downlink so the leaf fails
+        # loudly instead of serving a headless subtree forever.
+        uplink_t.start()
+        got, failures = _announce_until_first_message(uplink, dep)
+        up_state["got"], up_state["failures"] = got, failures
+        threading.Thread(target=uplink.run, daemon=True,
+                         name=f"leaf{dep.rank}-uplink").start()
+
+        def bridge() -> None:
+            uplink_t._stopped.wait()
+            if not leaf.done.is_set():
+                leaf.transport.stop()
+
+        threading.Thread(target=bridge, daemon=True,
+                         name=f"leaf{dep.rank}-uplink-bridge").start()
+
+    _serve_with_ready_barrier(leaf, dep, kickoff)
+    if leaf.failure is not None:
+        raise QuorumLostError(
+            f"leaf {dep.rank} aborted: {leaf.failure}"
+        )
+    if up_state["failures"]:
+        raise RuntimeError(up_state["failures"][0])
+    if up_state["got"] is not None:
+        _check_contacted(up_state["got"], dep)
+    if not leaf.done.is_set():
+        raise RuntimeError(
+            f"leaf {dep.rank} stopped before the root finished the "
+            f"run (version {leaf.round_idx})"
+        )
+    return {
+        "role": "leaf",
+        "rank": dep.rank,
+        "status": "finished",
+        "tier_spec": dep.tier_spec,
+        "client_base": base,
+        "partials": leaf.partials_sent,
+        "membership": leaf.membership,
+        "quarantined": leaf.quarantined_ranks,
+        "dead_peers": sorted(leaf.dead_peers),
+    }
+
+
 def _run_fedavg_rank(cfg: ExperimentConfig, dep: DeployConfig) -> dict:
     from fedml_tpu.algorithms.distributed_fedavg import (
         FedAvgClientActor,
@@ -512,6 +622,8 @@ def _run_fedavg_rank(cfg: ExperimentConfig, dep: DeployConfig) -> dict:
     from fedml_tpu.data.loaders import load_dataset
     from fedml_tpu.models import create_model
 
+    if dep.role == "leaf":
+        return _run_tier_leaf_rank(cfg, dep)
     # every rank rebuilds the identical seeded dataset + partition (the
     # reference ships the same data path to every MPI rank too,
     # main_fedavg.py load_data before FedML_FedAvg_distributed)
@@ -535,7 +647,41 @@ def _run_fedavg_rank(cfg: ExperimentConfig, dep: DeployConfig) -> dict:
             ckpt = RoundCheckpointer(os.path.join(_run_dir(cfg), "ckpt"))
         from fedml_tpu.core.reputation import QuarantinePolicy
 
-        server = FedAvgServerActor(
+        # actor-class selection (docs/FAULT_TOLERANCE.md "Async +
+        # tiered worlds"): async and/or tiered servers are strictly
+        # opt-in subclasses — with both knobs off this constructs the
+        # untouched FedAvgServerActor, byte-identical to every prior
+        # release (pinned in tests/test_async.py)
+        from fedml_tpu.core.async_agg import AsyncConfig
+        from fedml_tpu.core.tier import TierSpec
+
+        acfg = AsyncConfig.from_fed(cfg.fed)
+        extra = {}
+        if dep.tier_spec is not None:
+            from fedml_tpu.algorithms.async_actors import (
+                AsyncTierRootActor,
+                TierRootActor,
+            )
+
+            tier = TierSpec.parse(dep.tier_spec)
+            if dep.world_size != tier.root_world_size:
+                raise ValueError(
+                    f"--tier_spec {dep.tier_spec} implies a root "
+                    f"world of {tier.root_world_size} (root + "
+                    f"{tier.n_leaves} leaves), got --world_size "
+                    f"{dep.world_size}"
+                )
+            cls = AsyncTierRootActor if acfg.enabled() else TierRootActor
+            extra["tier_spec"] = tier
+        elif acfg.enabled():
+            from fedml_tpu.algorithms.async_actors import (
+                AsyncFedAvgServerActor,
+            )
+
+            cls = AsyncFedAvgServerActor
+        else:
+            cls = FedAvgServerActor
+        server = cls(
             dep.world_size, transport, model, cfg,
             num_clients=cfg.data.num_clients, data=data,
             round_policy=RoundPolicy(
@@ -550,6 +696,7 @@ def _run_fedavg_rank(cfg: ExperimentConfig, dep: DeployConfig) -> dict:
                 decay=dep.quarantine_decay,
                 evict_after=dep.quarantine_evict_after,
             ),
+            **extra,
         )
         try:
             if server.resumed_from >= cfg.fed.num_rounds:
@@ -615,6 +762,13 @@ def _run_fedavg_rank(cfg: ExperimentConfig, dep: DeployConfig) -> dict:
             # claims must be checkable against what actually ran
             "compress": cfg.fed.compress,
             "shard_aggregation": bool(cfg.fed.shard_aggregation),
+            # the async/tier plane in force (docs/FAULT_TOLERANCE.md
+            # "Async + tiered worlds"): 0 / None == the synchronous
+            # flat path ran, byte-identical to prior releases
+            "async_buffer_k": cfg.fed.async_buffer_k,
+            "async_restored_folds": getattr(server, "restored_folds",
+                                            0),
+            "tier_spec": dep.tier_spec,
             **metrics,
         }
 
@@ -1108,6 +1262,11 @@ def run_role(cfg: ExperimentConfig, dep: DeployConfig) -> dict:
     algo = cfg.fed.algorithm
     if algo in FEDAVG_FAMILY:
         return _run_fedavg_rank(cfg, dep)
+    if dep.role == "leaf":
+        raise ValueError(
+            f"--role leaf covers the fedavg family only (tier "
+            f"aggregation has no {algo!r} path)"
+        )
     if algo == "splitnn":
         return _run_splitnn_rank(cfg, dep)
     raise ValueError(

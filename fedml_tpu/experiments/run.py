@@ -104,6 +104,41 @@ def parse_args(argv=None) -> tuple[ExperimentConfig, argparse.Namespace]:
                         "all-gathering only the final params "
                         "(parallel/sharded_agg.py; the sims' sharded "
                         "runtime is ShardedFedAvg)")
+    # -- async + tiered aggregation (core/async_agg.py, core/tier.py;
+    # docs/FAULT_TOLERANCE.md "Async + tiered worlds") ---------------------
+    p.add_argument("--async_buffer_k", type=int, default=None,
+                   help="server rank: FedBuff-style buffered-async "
+                        "aggregation — fold every arriving screened "
+                        "delta into a staleness-weighted buffer and "
+                        "emit a new model every K arrivals, re-syncing "
+                        "each client individually the moment its "
+                        "result lands (no round barrier; a slow "
+                        "client never blocks a fast one). 0 (default) "
+                        "keeps the synchronous rounds byte-identical")
+    p.add_argument("--staleness_fn", type=str, default=None,
+                   choices=["poly", "const"],
+                   help="staleness discount for async folds: poly = "
+                        "(1+lag)^-alpha over the version lag, const = "
+                        "full weight for every arrival")
+    p.add_argument("--staleness_alpha", type=float, default=None,
+                   help="exponent of the poly staleness discount "
+                        "(0.5 = the FedAsync default)")
+    p.add_argument("--tier_spec", type=str, default=None,
+                   help="tier topology, e.g. root:2 — one root "
+                        "aggregator serving 2 leaf aggregators, each "
+                        "leaf terminating its own clients' transports "
+                        "in its own world and forwarding one partial "
+                        "[sum, n, count] upstream per flush. Set on "
+                        "the root (--role server) and every leaf "
+                        "(--role leaf); clients are topology-blind")
+    p.add_argument("--uplink_ip_config", type=str, default=None,
+                   help="leaf rank: the ROOT world's rank table "
+                        "(--ip_config stays this leaf's own world, "
+                        "where it is rank 0)")
+    p.add_argument("--tier_client_base", type=int, default=None,
+                   help="leaf rank: global client id of this leaf's "
+                        "slot 0 (default: contiguous equal-size "
+                        "blocks per leaf rank)")
     # -- seeded Byzantine adversary injection (core/adversary.py) ----------
     p.add_argument("--adversary_mode", type=str, default=None,
                    choices=["none", "sign_flip", "scale_boost", "gauss",
@@ -221,10 +256,10 @@ def parse_args(argv=None) -> tuple[ExperimentConfig, argparse.Namespace]:
     # surface: one OS process per rank; scripts/run_distributed.sh is the
     # localhost launcher) --------------------------------------------------
     p.add_argument("--role", type=str, default=None,
-                   choices=["server", "client"],
+                   choices=["server", "client", "leaf"],
                    help="run ONE deployment rank instead of the local "
-                        "simulator (requires --world_size; clients also "
-                        "--rank)")
+                        "simulator (requires --world_size; clients and "
+                        "leaf aggregators also --rank)")
     p.add_argument("--rank", type=int, default=None,
                    help="this process's rank (server=0, clients>=1)")
     p.add_argument("--world_size", type=int, default=None,
@@ -351,6 +386,9 @@ def parse_args(argv=None) -> tuple[ExperimentConfig, argparse.Namespace]:
             robust_multikrum_m=a.defense_multikrum_m,
             robust_trim_frac=a.defense_trim_frac,
             elastic_buckets=True if a.elastic else None,
+            async_buffer_k=a.async_buffer_k,
+            staleness_fn=a.staleness_fn,
+            staleness_alpha=a.staleness_alpha,
             compress=a.compress,
             compress_topk_frac=a.compress_topk_frac,
             shard_aggregation=True if a.shard_aggregation else None,
@@ -379,6 +417,9 @@ def parse_args(argv=None) -> tuple[ExperimentConfig, argparse.Namespace]:
     from fedml_tpu.core.reputation import QuarantinePolicy
     from fedml_tpu.core.robust import DefensePipeline, check_fednova_compat
 
+    from fedml_tpu.core.async_agg import AsyncConfig
+    from fedml_tpu.core.tier import TierSpec
+
     try:
         DefensePipeline.from_fed(cfg.fed)
         CompressionSpec.from_fed(cfg.fed)
@@ -386,6 +427,12 @@ def parse_args(argv=None) -> tuple[ExperimentConfig, argparse.Namespace]:
                          decay=a.quarantine_decay,
                          evict_after=a.quarantine_evict_after)
         check_fednova_compat(cfg.fed.algorithm, cfg.fed.robust_method)
+        AsyncConfig.from_fed(cfg.fed)
+        if a.tier_spec is not None:
+            TierSpec.parse(a.tier_spec)
+        from fedml_tpu.algorithms.async_actors import check_async_compat
+
+        check_async_compat(cfg)
     except ValueError as err:
         raise SystemExit(str(err))
     return cfg, a
@@ -438,11 +485,41 @@ def _deploy_config(a) -> "DeployConfig":
         )
     rank = a.rank if a.rank is not None else (0 if a.role == "server" else None)
     if rank is None:
-        raise SystemExit("--role client requires --rank >= 1")
+        raise SystemExit(f"--role {a.role} requires --rank >= 1")
     if a.role == "server" and rank != 0:
         raise SystemExit("server is always rank 0")
     if a.role == "client" and rank < 1:
         raise SystemExit("client rank must be >= 1")
+    if a.role == "leaf":
+        # a leaf aggregator lives in TWO worlds: rank 0 of its own
+        # leaf world (--ip_config) and member rank of the root world
+        # (--uplink_ip_config) — docs/FAULT_TOLERANCE.md "Async +
+        # tiered worlds"
+        if not a.tier_spec:
+            raise SystemExit("--role leaf requires --tier_spec")
+        if not a.uplink_ip_config:
+            raise SystemExit(
+                "--role leaf requires --uplink_ip_config (the root "
+                "world's rank table; --ip_config is this leaf's own "
+                "client-facing world)"
+            )
+        from fedml_tpu.core.tier import TierSpec
+
+        try:
+            spec = TierSpec.parse(a.tier_spec)
+        except ValueError as err:
+            raise SystemExit(str(err))
+        if not (1 <= rank <= spec.n_leaves):
+            raise SystemExit(
+                f"leaf rank must be in 1..{spec.n_leaves} of the root "
+                f"world ({a.tier_spec}), got {rank}"
+            )
+        if a.backend not in ("tcp", "grpc", "trpc"):
+            raise SystemExit(
+                "tier worlds need a rank-addressed backend "
+                "(tcp/grpc/trpc): the pub/sub topic space cannot host "
+                "two overlapping rank worlds on one broker"
+            )
     if (a.role == "client" and rank >= a.world_size
             and not a.elastic):
         # a rank beyond the launch world is a mid-run ADMISSION — it
@@ -511,6 +588,12 @@ def _deploy_config(a) -> "DeployConfig":
         leave_after_round=a.leave_after_round,
         presumed_left=tuple(a.presumed_left),
         presumed_evicted=tuple(a.presumed_evicted),
+        tier_spec=a.tier_spec,
+        uplink_ip_config=(
+            load_ip_config(a.uplink_ip_config)
+            if a.uplink_ip_config else None
+        ),
+        tier_client_base=a.tier_client_base,
     )
 
 
@@ -551,6 +634,13 @@ def _run_supervised(a, argv: list[str]) -> int:
         )
     if a.world_size is None or a.world_size < 2:
         raise SystemExit("--supervise requires --world_size >= 2")
+    if a.tier_spec:
+        raise SystemExit(
+            "--supervise launches one flat world (server + clients); "
+            "tier worlds span several worlds — start the root, "
+            "leaves, and clients explicitly (scripts/async_smoke.py "
+            "shows the shape)"
+        )
     if a.no_heartbeats:
         raise SystemExit(
             "--supervise requires the liveness protocol: after a "
@@ -635,6 +725,23 @@ def main(argv=None) -> int:
             "warning: --leave_after_round is a deployment flag and is "
             "ignored by the simulator (use --role client; "
             "set_cohort_size drives churn in the simulator)",
+            file=sys.stderr,
+        )
+    if cfg.fed.async_buffer_k:
+        # the async buffer lives in the deploy server actor: the
+        # compiled simulator IS one synchronous program — there is no
+        # arrival stream to fold without a barrier
+        print(
+            "warning: --async_buffer_k is a deployment flag and is "
+            "ignored by the simulator (use --role/--supervise; "
+            "docs/FAULT_TOLERANCE.md 'Async + tiered worlds')",
+            file=sys.stderr,
+        )
+    if a.tier_spec:
+        print(
+            "warning: --tier_spec is a deployment flag and is ignored "
+            "by the simulator (tier worlds are --role server/leaf/"
+            "client processes)",
             file=sys.stderr,
         )
     if cfg.fed.shard_aggregation:

@@ -107,9 +107,10 @@ class RoundCheckpointer:
                 if (
                     isinstance(raw, dict)
                     and {"server", "reputation"} <= set(raw)
-                    # tolerate later composite additions (membership)
+                    # tolerate later composite additions (membership,
+                    # the async staleness buffer)
                     and set(raw) <= {"server", "reputation",
-                                     "membership"}
+                                     "membership", "async"}
                     and not (isinstance(template, dict)
                              and "server" in template)
                 ):

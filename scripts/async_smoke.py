@@ -1,0 +1,187 @@
+"""CI smoke: an async + tiered gRPC world with a chaos-delayed straggler.
+
+Drives the asynchronous/hierarchical aggregation contract end to end
+over real sockets (docs/FAULT_TOLERANCE.md "Async + tiered worlds"):
+a ROOT aggregator (``--tier_spec root:2 --async_buffer_k 1``) serves
+two LEAF aggregators, each terminating two gRPC clients in its own
+leaf world — one client is a chaos-delayed straggler, so its whole
+leaf's partials arrive LATE while the sibling leaf keeps advancing the
+model version. The run must:
+
+- complete every emission (the root's summary reports all rounds and
+  a finite evaluation — the world converged);
+- fold the straggler leaf's late partials instead of dropping them
+  (``async.stale_folds > 0`` in the root's metrics — the
+  staleness-weighted buffer at work);
+- actually reduce near the wire (``tier.partial_sums > 0`` at the
+  root: every aggregate the root folded was a leaf partial, never a
+  raw client delta).
+
+The straggler client itself may exit nonzero: its final in-flight
+result legitimately races the world's FINISH teardown (the leaf's
+socket is already gone) — that race is the price of not waiting for
+stragglers, and the assertion set above is the contract that matters.
+
+Usage::
+
+    python scripts/async_smoke.py OUT_DIR
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import socket
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# emissions (model versions) the root must produce: enough that the
+# fast leaf's open loop spans several of the delayed leaf's slow
+# cycles — the straggler leaf must land >= 1 (stale) partial while the
+# world keeps moving
+ROUNDS = 40
+
+
+def _free_ports(n):
+    socks = [socket.socket() for _ in range(n)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def main(out_dir: str) -> int:
+    os.makedirs(out_dir, exist_ok=True)
+    cfg = {
+        "data": {"dataset": "fake_mnist", "num_clients": 4,
+                 "batch_size": 32, "partition_method": "homo", "seed": 0},
+        "model": {"name": "lr", "num_classes": 10,
+                  "input_shape": [28, 28, 1]},
+        "train": {"lr": 0.1, "epochs": 1},
+        "fed": {"algorithm": "fedavg", "num_rounds": ROUNDS,
+                "clients_per_round": 4, "eval_every": ROUNDS,
+                "async_buffer_k": 1, "staleness_fn": "poly"},
+        "seed": 0,
+        "run_name": "async_smoke",
+        "out_dir": out_dir,
+    }
+    cfg_path = os.path.join(out_dir, "cfg.json")
+    with open(cfg_path, "w") as f:
+        json.dump(cfg, f)
+    # three distinct worlds, nine listeners: the root world
+    # {0: root, 1..2: leaves} plus one leaf world per leaf
+    # {0: leaf, 1..2: its clients}
+    ports = _free_ports(9)
+    root_ip = os.path.join(out_dir, "root_world.json")
+    with open(root_ip, "w") as f:
+        json.dump({str(r): ["127.0.0.1", ports[r]] for r in range(3)}, f)
+    leaf_ips = {}
+    for leaf in (1, 2):
+        path = os.path.join(out_dir, f"leaf{leaf}_world.json")
+        base = 3 * leaf
+        with open(path, "w") as f:
+            json.dump({str(r): ["127.0.0.1", ports[base + r]]
+                       for r in range(3)}, f)
+        leaf_ips[leaf] = path
+    env = _env()
+    tdir = os.path.join(out_dir, "telemetry")
+
+    def spawn(argv):
+        return subprocess.Popen(
+            [sys.executable, "-m", "fedml_tpu.experiments.run",
+             "--config", cfg_path, "--backend", "grpc",
+             "--ready_timeout", "180", *argv],
+            env=env, cwd=REPO, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+        )
+
+    procs = {}
+    for leaf in (1, 2):
+        for r in (1, 2):
+            extra = []
+            if leaf == 2 and r == 2:
+                # THE straggler: every message this client sends or
+                # receives is chaos-delayed, so leaf 2's rounds close
+                # late and its partials arrive at the root with a
+                # version lag > 0
+                extra = ["--fault_seed", "7", "--fault_delay", "1.0",
+                         "--fault_delay_max", "0.6"]
+            procs[f"client{leaf}.{r}"] = spawn(
+                ["--role", "client", "--rank", str(r),
+                 "--world_size", "3", "--ip_config", leaf_ips[leaf],
+                 *extra])
+        procs[f"leaf{leaf}"] = spawn(
+            ["--role", "leaf", "--rank", str(leaf),
+             "--tier_spec", "root:2", "--world_size", "3",
+             "--ip_config", leaf_ips[leaf],
+             "--uplink_ip_config", root_ip,
+             "--telemetry_dir", os.path.join(tdir, f"leaf{leaf}")])
+    server = spawn(["--role", "server", "--tier_spec", "root:2",
+                    "--world_size", "3", "--ip_config", root_ip,
+                    "--telemetry_dir", tdir])
+
+    s_out = server.communicate(timeout=420)[0]
+    outs = {}
+    for name, p in procs.items():
+        try:
+            outs[name] = p.communicate(timeout=90)[0]
+        except subprocess.TimeoutExpired:
+            p.kill()
+            outs[name] = p.communicate()[0]
+    if server.returncode != 0:
+        raise SystemExit(f"root failed rc={server.returncode}:\n{s_out}")
+    summary = json.loads(s_out.strip().splitlines()[-1])
+
+    assert summary["rounds"] == ROUNDS, summary
+    assert summary["async_buffer_k"] == 1, summary
+    assert summary["tier_spec"] == "root:2", summary
+    # converged: the end-of-run evaluation ran and produced a finite
+    # loss on the emitted model
+    assert math.isfinite(summary.get("loss", float("nan"))), summary
+    for leaf in (1, 2):
+        p = procs[f"leaf{leaf}"]
+        assert p.returncode == 0, (leaf, outs[f"leaf{leaf}"])
+        leaf_summary = json.loads(
+            outs[f"leaf{leaf}"].strip().splitlines()[-1]
+        )
+        assert leaf_summary["status"] == "finished", leaf_summary
+        assert leaf_summary["partials"] > 0, leaf_summary
+    # the straggler may lose its final-result-vs-FINISH race (see
+    # module docstring); every OTHER client must exit clean
+    for name in ("client1.1", "client1.2", "client2.1"):
+        assert procs[name].returncode == 0, (name, outs[name])
+
+    with open(os.path.join(tdir, "metrics_rank0.json")) as f:
+        counters = json.load(f).get("counters", {})
+    stale = counters.get("async.stale_folds", 0)
+    partials = counters.get("tier.partial_sums", 0)
+    assert stale > 0, counters      # late partials FOLDED, not dropped
+    assert partials > 0, counters   # the root only ever saw partials
+    assert counters.get("async.emits", 0) == ROUNDS, counters
+
+    print(json.dumps({
+        "async_smoke": "ok",
+        "rounds": summary["rounds"],
+        "stale_folds": stale,
+        "partial_sums": partials,
+        "loss": summary.get("loss"),
+        "straggler_rc": procs["client2.2"].returncode,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) != 2:
+        raise SystemExit("usage: async_smoke.py OUT_DIR")
+    sys.exit(main(sys.argv[1]))

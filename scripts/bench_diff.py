@@ -39,7 +39,10 @@ import sys
 #: units where larger is better; anything in _LOWER regresses upward.
 #: Units in NEITHER table are compared as higher-is-better and the
 #: entry is annotated ``unit_assumed`` so a wrong guess is visible.
-_HIGHER = ("rounds/sec", "hit_rate", "% test acc", "accuracy", "acc")
+_HIGHER = ("rounds/sec", "hit_rate", "% test acc", "accuracy", "acc",
+           # async/tier stage (bench --async-bench): emit throughput
+           # per fan-in and the headline fan-in scaling ratio
+           "emits/sec", "ratio")
 _LOWER = ("seconds", "ms/round", "s", "ms", "MB/round")
 
 
