@@ -620,6 +620,103 @@ def time_to_acc_record(sim, label: str, target: float,
     }
 
 
+def _compiled_block(sim, fuse: int):
+    """AOT-compile the FUSED block (``FedAvgSim._fused_block``: K
+    complete rounds as one lax.scan program, state donated) once; same
+    warmup discipline as :func:`_compiled_round`."""
+    import jax
+
+    state = sim.init()
+    compiled = (
+        jax.jit(sim._fused_block, static_argnums=(4,),
+                donate_argnums=(0,))
+        .lower(state, sim.arrays, None, None, fuse)
+        .compile()
+    )
+    run_block = lambda st: compiled(st, sim.arrays, None, None)
+    state, _ = run_block(state)  # warmup (execute once)
+    jax.block_until_ready(jax.tree.leaves(state))
+    return run_block, state
+
+
+def fused_rate_bench(sim, rounds: int, fuse: int):
+    """Fetch-corrected round rate of the FUSED path: the same 3-window
+    best-of discipline as :func:`rate_bench`, stepping in blocks of
+    ``fuse`` rounds (the per-round host turnaround — the ~5% MFU
+    culprit, docs/PERFORMANCE.md "Round fusion" — is paid once per
+    block)."""
+    import jax
+
+    run_block, state = _compiled_block(sim, fuse)
+    fetch_samples = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        float(np.asarray(jax.device_get(state.round)))
+        fetch_samples.append(time.perf_counter() - t0)
+    fetch_cost = min(fetch_samples)
+
+    blocks = max(1, rounds // fuse)
+    windows = min(3, blocks)
+    per = blocks // windows
+    sizes = [per] * windows
+    sizes[-1] += blocks - per * windows
+    rates = []
+    for size in sizes:
+        t0 = time.perf_counter()
+        for _ in range(size):
+            state, m = run_block(state)
+        # sync on a stacked metric leaf (device_get is the only
+        # reliable sync on the tunnelled backend)
+        np.asarray(jax.device_get(next(iter(m.values()))))
+        wall = time.perf_counter() - t0
+        dt = max(wall - fetch_cost, wall / 2)
+        rates.append(size * fuse / dt)
+    return max(rates), float(np.median(rates)), rates
+
+
+def fused_rate_records(sim, metric: str, rounds: int,
+                       fuse: int) -> list[dict]:
+    """The fused variant of a headline rate metric (``..._fused``),
+    plus a companion TRACKED ``mfu`` record — the acceptance surface of
+    the round-fusion PR is the MFU number itself, so it must be a
+    ``value`` bench_diff watches, not a side-field. No torch baseline:
+    the serial reference has no fused analog, and ``vs_baseline`` for
+    fusion is just the unfused metric one record up."""
+    import jax
+
+    rps, rps_median, rates = fused_rate_bench(sim, rounds, fuse)
+    flops = useful_round_cost(sim)
+    kind = jax.devices()[0].device_kind
+    peak_flops, _ = PEAKS.get(kind, (None, None))
+    delivered = flops * rps if flops else None
+    mfu = delivered / peak_flops if delivered and peak_flops else None
+    rec = {
+        "metric": metric,
+        "value": round(rps, 4),
+        "unit": "rounds/sec",
+        "vs_baseline": None,
+        "value_median": round(rps_median, 4),
+        "window_rates": [round(r, 4) for r in rates],
+        "fuse_rounds": fuse,
+        "delivered_tflops": float(f"{delivered / 1e12:.3g}")
+        if delivered else None,
+        "mfu": float(f"{mfu:.3g}") if mfu else None,
+        "device": kind,
+    }
+    out = [rec]
+    if mfu is not None:
+        out.append({
+            "metric": metric.replace("rounds_per_sec", "mfu"),
+            "value": float(f"{mfu:.3g}"),
+            "unit": "mfu",
+            "vs_baseline": None,
+            "fuse_rounds": fuse,
+            "rounds_per_sec": round(rps, 4),
+            "device": kind,
+        })
+    return out
+
+
 # ---------------------------------------------------------------------------
 # BASELINE.json config families (VERDICT r3 item 2): one rounds/sec +
 # MFU + vs-serial-torch line per family, each at its reference benchmark
@@ -1729,6 +1826,17 @@ def main():
                          "10k-client world at fan-in {1,2,4} leaves "
                          "(real measured fold/emit costs; the "
                          "tracked number is the SCALING RATIO)")
+    ap.add_argument("--fused-bench", action="store_true",
+                    help="ONLY the round-fusion stage: the headline "
+                         "and s2d rate metrics re-measured with K "
+                         "rounds fused into one compiled lax.scan "
+                         "program (..._fused, docs/PERFORMANCE.md "
+                         "'Round fusion'), each with a companion "
+                         "TRACKED mfu record — the acceptance "
+                         "surface of the MFU-recovery claim")
+    ap.add_argument("--fuse-rounds", type=int, default=8,
+                    help="block length K for the fused stages "
+                         "(rounds per compiled program)")
     ap.add_argument("--fallback-only", action="store_true",
                     help="emit ONLY the marked CPU-fallback record "
                          "(+ one small labeled CPU measurement): the "
@@ -1863,6 +1971,18 @@ def main():
     if args.wire_bench:
         for rec in staged("wire", wire_bench_records):
             emit(rec)
+        return
+    if args.fused_bench:
+        for name in ("resnet56", "resnet56_s2d"):
+            sim, _ = build_sim(model_name=name)
+            metric = f"fedavg_rounds_per_sec_100c_cifar10_{name}_fused"
+            for rec in staged(
+                f"rate.{name}_fused",
+                lambda sim=sim, metric=metric: fused_rate_records(
+                    sim, metric, args.rounds, args.fuse_rounds),
+            ):
+                emit(rec)
+            del sim
         return
     if args.synthetic_acc:
         rec = staged("synthetic_acc", synthetic_leaf_acc_record)
@@ -2000,6 +2120,21 @@ def main():
             args.rounds, "resnet56", args.skip_torch_baseline,
         ),
     ))
+    try:
+        # round fusion on the SAME sim (docs/PERFORMANCE.md "Round
+        # fusion"): K rounds per compiled program + one companion
+        # tracked mfu record — the MFU-recovery acceptance surface,
+        # tracked by bench_diff from this PR on
+        for rec in staged(
+            "rate.resnet56_fused",
+            lambda: fused_rate_records(
+                sim, "fedavg_rounds_per_sec_100c_cifar10_resnet56_fused",
+                args.rounds, args.fuse_rounds),
+        ):
+            emit(rec)
+    except Exception as err:
+        print(f"[bench] fused stage failed: {err}", file=sys.stderr,
+              flush=True)
     del sim
     ns, _ = build_sim(num_clients=1000, full_cifar=True,
                       model_name="resnet56_s2d")
@@ -2030,6 +2165,18 @@ def main():
             args.rounds, "resnet56_s2d", args.skip_torch_baseline,
         ),
     ))
+    try:
+        for rec in staged(
+            "rate.s2d_fused",
+            lambda: fused_rate_records(
+                s2d_sim,
+                "fedavg_rounds_per_sec_100c_cifar10_resnet56_s2d_fused",
+                args.rounds, args.fuse_rounds),
+        ):
+            emit(rec)
+    except Exception as err:
+        print(f"[bench] s2d fused stage failed: {err}", file=sys.stderr,
+              flush=True)
     del s2d_sim
 
 

@@ -163,6 +163,14 @@ print(f"perf smoke ok: {len(perf['rounds'])} profiled rounds, "
       f"{len(rows)} time-series rows")
 EOF
 
+echo "== fuse smoke: --fuse_rounds 4 parity + one compile per (bucket, K) =="
+# a tiny sim fused at K=4 must reproduce the unfused run's final loss,
+# compile exactly one block program per (bucket, block length), log a
+# stacked metrics row for every round, and flush eval on the exact
+# boundary rounds even though eval_every % K != 0
+# (docs/PERFORMANCE.md "Round fusion")
+JAX_PLATFORMS=cpu python scripts/fuse_smoke.py
+
 echo "== bench_diff (advisory): newest two BENCH artifacts =="
 # regression comparator over the last two driver BENCH records —
 # advisory only (the artifacts may legitimately span a TPU-down round,

@@ -341,6 +341,30 @@ class FedAvgSim:
         self._ef_residual = None  # lazy zero carry, [bucket, ...]
         donate = (0, 3) if self._cspec.enabled() else (0,)
         self._round_fn = jax.jit(self._round, donate_argnums=donate)
+        # -- fused multi-round execution (core/fuse.py, docs/
+        # PERFORMANCE.md "Round fusion"): with fuse_rounds K > 1 ONE
+        # compiled program runs K complete rounds as a lax.scan over
+        # the round body — ServerState (and the error-feedback
+        # residual) ride as donated scan carries, per-round train
+        # metrics stack into [K, ...] outputs the driver consumes once
+        # per block. Cohort sampling folds in the CARRIED round
+        # counter, so the sampled cohorts are bitwise-identical to the
+        # unfused loop's. K = 1 (the default) never builds the block
+        # program: the per-round path stays byte-identical.
+        fuse = cfg.fed.fuse_rounds
+        self._fuse = 1 if fuse is None else int(fuse)
+        if self._fuse < 1:
+            raise ValueError(
+                f"fuse_rounds must be >= 1, got {cfg.fed.fuse_rounds}"
+            )
+        # the sharded runtime rebinds this to its shard_map'd round so
+        # the SAME fused-block scan wraps either body
+        self._round_impl = self._round
+        self._block_fn = (
+            jax.jit(self._fused_block, static_argnums=(4,),
+                    donate_argnums=donate)
+            if self._fuse > 1 else None
+        )
 
     def _prepare_data(self, data: FederatedData, cfg: ExperimentConfig):
         """Resolve device data + batch size. The mesh-sharded subclass
@@ -584,6 +608,86 @@ class FedAvgSim:
             return new_state, train_metrics, new_residual
         return new_state, train_metrics
 
+    def _fused_block(self, state: ServerState, operand, n_active=None,
+                     residual=None, length: int = 1):
+        """``length`` complete rounds as ONE program: a ``lax.scan``
+        over the round body with (state[, EF residual]) as the carry.
+        Each iteration derives its round key from the CARRIED
+        ``state.round`` (``_locals`` folds it in), so sampling,
+        adversary injection, and the compression quantizer draws are
+        bitwise-identical to ``length`` separate ``_round`` calls —
+        only XLA's cross-iteration fusion may reassociate float sums
+        (the PR-5/PR-7 band, pinned in tests/test_fuse.py). The
+        elastic live count is a scan-invariant traced operand: churn
+        mid-block is impossible by construction — ``set_cohort_size``
+        lands at the next block boundary. Metric leaves stack to
+        ``[length, ...]``."""
+        if residual is not None:
+            def body(carry, _):
+                s, res = carry
+                s, m, res = self._round_impl(s, operand, n_active, res)
+                return (s, res), m
+
+            (state, residual), ms = jax.lax.scan(
+                body, (state, residual), None, length=length
+            )
+            return state, ms, residual
+
+        def body(carry, _):
+            s, m = self._round_impl(carry, operand, n_active)
+            return s, m
+
+        state, ms = jax.lax.scan(body, state, None, length=length)
+        return state, ms
+
+    def _round_operand(self):
+        """Device operand the round body trains from (the sharded
+        runtime overrides this with its per-shard banks)."""
+        return self.arrays
+
+    def run_block(self, state: ServerState, length: int):
+        """Run ``length`` complete rounds as one compiled block
+        (:meth:`_fused_block`); returns ``(state, metrics)`` with every
+        metric leaf stacked ``[length, ...]``. Requires
+        ``FedConfig(fuse_rounds > 1)`` — the block program is built at
+        construction. Distinct ``length`` values are distinct compiles
+        (``core.fuse.plan_blocks`` keeps the set tiny: the configured K
+        plus the remainders eval/checkpoint boundaries force)."""
+        if self._block_fn is None:
+            raise ValueError(
+                "run_block requires FedConfig(fuse_rounds > 1) — the "
+                "fused block program is built at construction"
+            )
+        compressed = self._cspec.enabled()
+        if compressed and self._ef_residual is None:
+            self._ef_residual = C.zero_residual(
+                state.variables, self._bucket
+            )
+            telemetry.METRICS.gauge(
+                "compress.ratio",
+                C.wire_ratio(self._cspec, state.variables),
+            )
+        operand = self._round_operand()
+        n = (
+            jnp.asarray(self._n_active, jnp.int32)
+            if self._elastic else None
+        )
+
+        def call():
+            return self._block_fn(
+                state, operand, n,
+                self._ef_residual if compressed else None, length,
+            )
+
+        out = (
+            E.mirror_jit_cache(self._block_fn, call)
+            if self._elastic else call()
+        )
+        if compressed:
+            state, m, self._ef_residual = out
+            return state, m
+        return out
+
     # -- public API --------------------------------------------------------
     def run_round(self, state: ServerState):
         compressed = self._cspec.enabled()
@@ -638,7 +742,9 @@ class FedAvgSim:
         gauges — round rate, MFU from the shared analytic cost model,
         and the dispatch-bound detector — for every round. The round
         wall time is taken AFTER the metric host conversion forces the
-        device, so it measures execution, not dispatch."""
+        device, so it measures execution, not dispatch. With
+        ``cfg.fed.fuse_rounds > 1`` the loop advances in fused blocks
+        with pipelined host consumption (:meth:`_run_fused`)."""
         import time as _time
 
         from fedml_tpu.core import perf as P
@@ -646,12 +752,20 @@ class FedAvgSim:
         state = self.init()
         profiler, monitor = P.build_sim_perf(self)
         try:
+            if self._fuse > 1:
+                return self._run_fused(
+                    state, metrics_sink, profiler, monitor, _time
+                )
             for r in range(self.cfg.fed.num_rounds):
                 t0 = _time.perf_counter()
                 if profiler is not None:
                     profiler.start_round(r)
                 state, train_m = self.run_round(state)
-                train_m = consume_round_counters(dict(train_m))
+                # ONE batched D2H for the whole metric dict instead of
+                # a device sync per leaf
+                train_m = consume_round_counters(
+                    jax.device_get(dict(train_m))
+                )
                 record = {
                     "round": r,
                     **{k: float(v) for k, v in train_m.items()},
@@ -674,3 +788,56 @@ class FedAvgSim:
             if profiler is not None:
                 profiler.finish()
         return state
+
+    def _run_fused(self, state, metrics_sink, profiler, monitor, _time):
+        """Fused round loop (docs/PERFORMANCE.md "Round fusion"):
+        advance in blocks of up to ``fuse_rounds`` rounds, keeping
+        block k+1's dispatch in flight while the host converts block
+        k's stacked metrics (one batched transfer per block), and
+        syncing only at eval boundaries and profiler-capture windows.
+        The loop itself is ``core.fuse.drive`` (shared with the
+        harness's fused loop); boundary placement
+        (``core.fuse.plan_blocks``) guarantees eval runs on exactly
+        the same round's state as the unfused loop, even when
+        ``eval_every % fuse_rounds != 0``."""
+        from fedml_tpu.core import fuse as F
+
+        cfg = self.cfg.fed
+        box = [state]
+
+        def run_block(length):
+            box[0], dm = self.run_block(box[0], length)
+            return dm
+
+        def make_records(start, rows):
+            return [
+                {"round": start + i,
+                 **{k: float(v) for k, v in
+                    consume_round_counters(row).items()}}
+                for i, row in enumerate(rows)
+            ]
+
+        def log(rec):
+            if metrics_sink is not None:
+                metrics_sink.log(rec)
+
+        def boundary_hook(r_last, last):
+            if (r_last + 1) % cfg.eval_every == 0 or (
+                r_last == cfg.num_rounds - 1
+            ):
+                test_m = self.evaluate_global(box[0])
+                last.update({"test_acc": test_m["acc"],
+                             "test_loss": test_m["loss"]})
+            log(last)
+
+        F.drive(
+            run_block,
+            F.plan_blocks(0, cfg.num_rounds, self._fuse,
+                          cfg.eval_every),
+            profiler=profiler,
+            monitor=monitor,
+            make_records=make_records,
+            log=log,
+            boundary_hook=boundary_hook,
+        )
+        return box[0]

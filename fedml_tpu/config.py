@@ -166,6 +166,16 @@ class FedConfig:
     # (round rate, MFU, dispatch-bound detector) for the whole run.
     # 0 = off (no capture, no gauges, no extra cost-analysis compile).
     profile_rounds: int = 0
+    # fused multi-round execution (core/fuse.py, docs/PERFORMANCE.md
+    # "Round fusion"): run K complete rounds as ONE compiled program —
+    # a lax.scan over the round body with the server state (and the
+    # error-feedback residual) as donated carries and per-round train
+    # metrics stacked into [K, ...] outputs consumed host-side once
+    # per block. Cohort sampling inside the scan folds in the carried
+    # round counter, so the sampled cohorts are bitwise-identical to
+    # the unfused loop's. 1 (default) keeps the per-round loop
+    # byte-identical; simulator paths only (FedAvgSim/ShardedFedAvg).
+    fuse_rounds: int = 1
 
 
 @dataclasses.dataclass(frozen=True)

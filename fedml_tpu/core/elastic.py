@@ -155,7 +155,13 @@ def mirror_jit_cache(round_fn, call):
 
 
 class CompiledRoundCache:
-    """LRU of ahead-of-time compiled executables, keyed by bucket size.
+    """LRU of ahead-of-time compiled executables, keyed by any hashable
+    — the deploy paths key by bucket size; a caller whose executables
+    vary on more than shape may compound the key (e.g. ``(bucket,
+    block_length)``; each (shape, scan-length) pair is its own
+    executable). Note the fused SIM paths do not route through this
+    cache: their block programs live in ``jax.jit``'s own cache, with
+    hits/misses mirrored by :func:`mirror_jit_cache`.
 
     ``jax.jit`` already caches by shape, but it neither evicts nor
     reports — an elastic server that saw 40 distinct cohort sizes would
@@ -178,13 +184,16 @@ class CompiledRoundCache:
         self._static_argnums = tuple(static_argnums)
         self._jit_kwargs = dict(jit_kwargs or {})
         self.max_entries = max_entries
-        self._cache: OrderedDict[int, object] = OrderedDict()
+        self._cache: OrderedDict[object, object] = OrderedDict()
         self._lock = threading.Lock()
         # local mirror of the telemetry counters so tests (and callers
         # running with the metrics plane off) can still read hit rates
         self.stats = {"hits": 0, "misses": 0, "evictions": 0}
 
-    def __call__(self, bucket: int, *args):
+    def __call__(self, bucket, *args):
+        """``bucket`` is the cache key — an int bucket size on the
+        classic paths; any hashable works for callers whose
+        executables vary on more than shape."""
         with self._lock:
             exe = self._cache.get(bucket)
             if exe is not None:

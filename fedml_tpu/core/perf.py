@@ -358,15 +358,30 @@ class RoundProfiler:
     """
 
     def __init__(self, rounds: int, out_dir: str, tag: str | None = None,
-                 flops_per_round: float | None = None):
+                 flops_per_round: float | None = None,
+                 fuse_rounds: int = 1):
         self.rounds = int(rounds)
         self.out_dir = out_dir
         self.tag = tag or telemetry.rank_tag()
         self.flops_per_round = flops_per_round
+        # under round fusion (--fuse_rounds K) a capture window spans a
+        # whole K-round BLOCK — recorded in the manifest and the
+        # breakdown rows so a per-block breakdown is never silently
+        # read as per-round (docs/PERFORMANCE.md "Round fusion")
+        self.fuse_rounds = max(1, int(fuse_rounds or 1))
         self.capture_dir = os.path.join(out_dir, "jax_profile")
         self.breakdowns: list[dict] = []
         self._active: tuple[int, str, float, float] | None = None
         self._broken = False
+
+    @property
+    def wants_capture(self) -> bool:
+        """True while another capture window can open (budget left, no
+        window active, profiler healthy). The fused round loop checks
+        this to drain its metric pipeline around profiled blocks, so a
+        capture contains exactly one block's device work."""
+        return (not self._broken and self._active is None
+                and len(self.breakdowns) < self.rounds)
 
     def start_round(self, round_idx: int) -> None:
         if (self._broken or self._active is not None
@@ -385,10 +400,13 @@ class RoundProfiler:
             return
         self._active = (round_idx, d, time.perf_counter(), time.time())
 
-    def end_round(self, round_idx: int) -> None:
+    def end_round(self, round_idx: int, rounds: int = 1) -> None:
         """Close the window opened for ``round_idx`` (call AFTER the
         round's metrics were forced to host, so the capture contains
-        the device execution, not just the dispatch)."""
+        the device execution, not just the dispatch). Under round
+        fusion the window covers a whole block: pass ``rounds`` so the
+        manifest and the breakdown row say how many rounds the window
+        actually contains."""
         if self._active is None or self._active[0] != round_idx:
             return
         import jax
@@ -404,7 +422,9 @@ class RoundProfiler:
                                       error=repr(err))
             return
         manifest = {"round": round_idx, "t_start": epoch0,
-                    "window_s": window_s}
+                    "window_s": window_s,
+                    "fuse_rounds": self.fuse_rounds,
+                    "rounds_in_window": int(rounds)}
         try:
             with open(os.path.join(d, "capture.json"), "w") as f:
                 json.dump(manifest, f)
@@ -413,6 +433,7 @@ class RoundProfiler:
         bd = device_time_breakdown(load_trace_events(d),
                                    window_s=window_s)
         bd["round"] = round_idx
+        bd["rounds_in_window"] = int(rounds)
         self.breakdowns.append(bd)
         m = telemetry.METRICS
         m.inc("perf.profiled_rounds")
@@ -452,6 +473,7 @@ class RoundProfiler:
                 json.dump({
                     "tag": self.tag,
                     "flops_per_round": self.flops_per_round,
+                    "fuse_rounds": self.fuse_rounds,
                     "rounds": self.breakdowns,
                     "mean": mean,
                 }, f, indent=2)
@@ -510,19 +532,39 @@ class PerfMonitor:
         return self.flops_per_round / (self._avg_wall * self.peak_flops)
 
     def note_round(self, wall_s: float) -> None:
-        if wall_s <= 0:
+        self.note_block(wall_s, 1)
+
+    def note_block(self, wall_s: float, rounds: int,
+                   compiled: bool = False) -> None:
+        """One completed fused block of ``rounds`` rounds: the wall
+        DIVIDES by the round count before feeding the SLO histogram,
+        the EWMA, and the MFU gauge, so the per-round surface stays
+        honest under ``--fuse_rounds`` (a 4-round block at 2 s is
+        0.5 s/round, never a 2 s p99 outlier) and the dispatch-bound
+        detector keeps comparing per-round numbers. Excluded whole —
+        wall gauged as ``perf.warmup_round_wall_s`` instead — are a
+        block containing ANY warmup round AND any block flagged
+        ``compiled`` (the fused drivers flag the first dispatch of
+        each distinct block length: eval/checkpoint remainders trace a
+        fresh scan program post-warmup, and that compile must not
+        become the p99 or trip the dispatch-bound detector).
+        ``note_round`` is the ``rounds=1`` case."""
+        if wall_s <= 0 or rounds <= 0:
             return
-        self.rounds += 1
-        if self.rounds <= self.warmup_rounds:
-            telemetry.METRICS.gauge("perf.warmup_round_wall_s", wall_s)
+        first = self.rounds
+        self.rounds += rounds
+        per = wall_s / rounds
+        if compiled or first < self.warmup_rounds:
+            telemetry.METRICS.gauge("perf.warmup_round_wall_s", per)
             return
         self._avg_wall = (
-            wall_s if self._avg_wall is None
-            else (self.smoothing * wall_s
+            per if self._avg_wall is None
+            else (self.smoothing * per
                   + (1 - self.smoothing) * self._avg_wall)
         )
         m = telemetry.METRICS
-        m.observe("perf.round_wall_s", wall_s)
+        for _ in range(rounds):
+            m.observe("perf.round_wall_s", per)
         m.gauge("perf.rounds_per_s", 1.0 / self._avg_wall)
         if self.flops_per_round:
             m.gauge("perf.delivered_flops_per_s",
@@ -532,7 +574,7 @@ class PerfMonitor:
             return
         m.gauge("perf.mfu", mfu)
         if mfu < self.mfu_floor:
-            m.inc("perf.dispatch_bound_rounds")
+            m.inc("perf.dispatch_bound_rounds", rounds)
             m.gauge("perf.latency_bound", 1.0)
             if not self._flagged:
                 self._flagged = True
@@ -576,7 +618,9 @@ def build_sim_perf(sim) -> tuple[RoundProfiler | None,
     mesh = getattr(sim, "mesh", None)
     n_dev = int(mesh.devices.size) if mesh is not None else 1
     peak = device_peak_flops(jax.devices()[0].device_kind)
-    profiler = RoundProfiler(k, out_dir, flops_per_round=flops)
+    fuse = int(getattr(cfg.fed, "fuse_rounds", 1) or 1)
+    profiler = RoundProfiler(k, out_dir, flops_per_round=flops,
+                             fuse_rounds=fuse)
     monitor = PerfMonitor(
         flops_per_round=flops,
         peak_flops=peak * n_dev if peak else None,

@@ -431,6 +431,22 @@ class Experiment:
     @staticmethod
     def _instrumented_loop(sim, cfg, sink, state, start_round, ckpt,
                            profiler, monitor, _time):
+        fuse = int(getattr(cfg.fed, "fuse_rounds", 1) or 1)
+        if fuse > 1:
+            if hasattr(sim, "run_block") and state is not None:
+                return Experiment._fused_loop(
+                    sim, cfg, sink, state, start_round, ckpt, profiler,
+                    monitor, _time,
+                )
+            import warnings
+
+            warnings.warn(
+                f"fuse_rounds={fuse} ignored: {type(sim).__name__} "
+                "does not expose the run_block state protocol (round "
+                "fusion covers the FedAvg-family compiled sims); "
+                "running per-round",
+                stacklevel=2,
+            )
         for r in range(start_round, cfg.fed.num_rounds):
             t0 = _time.perf_counter()
             if profiler is not None:
@@ -454,7 +470,7 @@ class Experiment:
                     consume_round_counters,
                 )
 
-                m = consume_round_counters(dict(m))
+                m = consume_round_counters(_batched_get(dict(m)))
                 record.update({k: _f(v) for k, v in m.items()
                                if _scalar(v)})
             # the scalar conversion above forced the round's metrics to
@@ -467,27 +483,90 @@ class Experiment:
             if (r + 1) % cfg.fed.eval_every == 0 or (
                 r == cfg.fed.num_rounds - 1
             ):
-                for ev_name in ("evaluate_global", "evaluate_clients",
-                                "evaluate_consensus", "evaluate"):
-                    if hasattr(sim, ev_name):
-                        ev = getattr(sim, ev_name)(state) if state is not \
-                            None else getattr(sim, ev_name)()
-                        # evaluators on a TEST split return bare
-                        # {acc, loss}; normalize to the test_* names the
-                        # summary consumers (battery table, wandb
-                        # groupings) key on
-                        rename = {"acc": "test_acc", "loss": "test_loss"}
-                        record.update(
-                            {rename.get(k, k): _f(v)
-                             for k, v in ev.items() if _scalar(v)}
-                        )
-                        break
+                record.update(Experiment._eval_record(sim, state))
             sink.log(record)
             if ckpt is not None and (
                 (r + 1) % cfg.checkpoint_every == 0
                 or r == cfg.fed.num_rounds - 1
             ):
                 ckpt.save(r, state)
+
+    @staticmethod
+    def _eval_record(sim, state) -> dict:
+        """Run the sim's evaluator (first of the known protocol names)
+        and normalize bare test-split {acc, loss} to the test_* names
+        the summary consumers (battery table, wandb groupings) key
+        on."""
+        for ev_name in ("evaluate_global", "evaluate_clients",
+                        "evaluate_consensus", "evaluate"):
+            if hasattr(sim, ev_name):
+                ev = getattr(sim, ev_name)(state) if state is not \
+                    None else getattr(sim, ev_name)()
+                rename = {"acc": "test_acc", "loss": "test_loss"}
+                return {rename.get(k, k): _f(v)
+                        for k, v in ev.items() if _scalar(v)}
+        return {}
+
+    @staticmethod
+    def _fused_loop(sim, cfg, sink, state, start_round, ckpt, profiler,
+                    monitor, _time):
+        """Block-driven round loop for run_block sims (docs/
+        PERFORMANCE.md "Round fusion"): dispatch blocks of up to
+        ``fuse_rounds`` rounds, convert the PREVIOUS block's stacked
+        metrics while the current one runs on device (one batched
+        transfer per block), and sync only at eval / checkpoint /
+        profiler-capture boundaries. The loop itself is
+        ``core.fuse.drive`` (shared with ``FedAvgSim._run_fused``);
+        ``core.fuse.plan_blocks`` places boundaries so evaluation and
+        checkpoints see exactly the same round's state as the
+        per-round loop."""
+        from fedml_tpu.core import fuse as F
+        from fedml_tpu.algorithms.fedavg import consume_round_counters
+
+        ckpt_every = cfg.checkpoint_every if ckpt is not None else 0
+        total = cfg.fed.num_rounds
+        box = [state]
+
+        def run_block(length):
+            box[0], dm = sim.run_block(box[0], length)
+            return dm
+
+        def make_records(start, rows):
+            records = []
+            for i, row in enumerate(rows):
+                row = consume_round_counters(row)
+                rec = {"round": start + i}
+                if start_round:
+                    rec["resumed"] = True
+                rec.update({k: _f(v) for k, v in row.items()
+                            if _scalar(v)})
+                records.append(rec)
+            return records
+
+        def boundary_hook(r_last, last):
+            if (r_last + 1) % cfg.fed.eval_every == 0 or (
+                r_last == total - 1
+            ):
+                last.update(Experiment._eval_record(sim, box[0]))
+            sink.log(last)
+            if ckpt is not None and (
+                (r_last + 1) % cfg.checkpoint_every == 0
+                or r_last == total - 1
+            ):
+                ckpt.save(r_last, box[0])
+
+        F.drive(
+            run_block,
+            F.plan_blocks(start_round, total, int(cfg.fed.fuse_rounds),
+                          cfg.fed.eval_every, ckpt_every),
+            profiler=profiler,
+            monitor=monitor,
+            make_records=make_records,
+            log=sink.log,
+            boundary_hook=boundary_hook,
+            span=lambda start, rounds: telemetry.maybe_span(
+                "sim_block", start=start, rounds=rounds),
+        )
 
 
 def _wants_round(sim) -> bool:
@@ -513,6 +592,19 @@ def _run_accepts_sink(sim) -> bool:
     return "metrics_sink" in params or any(
         p.kind == inspect.Parameter.VAR_KEYWORD for p in params.values()
     )
+
+
+def _batched_get(m: dict) -> dict:
+    """Fetch every device-array leaf of a round's metric dict in ONE
+    batched ``jax.device_get`` (async copies first, then one block)
+    instead of a device sync per ``float(leaf)``; non-array values
+    (host-driven sims mix types) pass through untouched."""
+    import jax
+
+    arrs = {k: v for k, v in m.items() if isinstance(v, jax.Array)}
+    if arrs:
+        m = {**m, **jax.device_get(arrs)}
+    return m
 
 
 def _scalar(v) -> bool:

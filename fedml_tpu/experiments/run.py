@@ -235,6 +235,19 @@ def parse_args(argv=None) -> tuple[ExperimentConfig, argparse.Namespace]:
                         "jax.profiler.TraceAnnotation so device "
                         "timelines line up with host spans in a jax "
                         "profile")
+    # -- round fusion (core/fuse.py; docs/PERFORMANCE.md "Round
+    # fusion") --------------------------------------------------------------
+    p.add_argument("--fuse_rounds", type=int, default=None,
+                   help="simulator: run K complete rounds as ONE "
+                        "compiled program (a lax.scan over the round "
+                        "body, state + error-feedback residual as "
+                        "donated carries) with per-block host metric "
+                        "consumption — the MFU-recovery path. Cohort "
+                        "sampling inside the fused block is bitwise-"
+                        "identical to the unfused loop; eval/"
+                        "checkpoint rounds force a block boundary. 1 "
+                        "(default) keeps the per-round loop byte-"
+                        "identical. FedAvg-family sims only")
     # -- performance observability (docs/OBSERVABILITY.md) -----------------
     p.add_argument("--profile_rounds", type=int, default=None,
                    help="capture a jax.profiler window around each of "
@@ -393,6 +406,7 @@ def parse_args(argv=None) -> tuple[ExperimentConfig, argparse.Namespace]:
             compress_topk_frac=a.compress_topk_frac,
             shard_aggregation=True if a.shard_aggregation else None,
             profile_rounds=a.profile_rounds,
+            fuse_rounds=a.fuse_rounds,
         ),
         adversary=rep(
             cfg.adversary,
@@ -420,6 +434,10 @@ def parse_args(argv=None) -> tuple[ExperimentConfig, argparse.Namespace]:
     from fedml_tpu.core.async_agg import AsyncConfig
     from fedml_tpu.core.tier import TierSpec
 
+    if cfg.fed.fuse_rounds < 1:
+        raise SystemExit(
+            f"--fuse_rounds must be >= 1, got {cfg.fed.fuse_rounds}"
+        )
     try:
         DefensePipeline.from_fed(cfg.fed)
         CompressionSpec.from_fed(cfg.fed)
@@ -541,6 +559,16 @@ def _deploy_config(a) -> "DeployConfig":
             "reports perf.agg_wall_s / perf.host_wait_s / idle-gap "
             "signals instead (docs/OBSERVABILITY.md 'Performance "
             "observability')",
+            file=sys.stderr,
+        )
+    if a.fuse_rounds and a.fuse_rounds > 1:
+        # rounds on the deploy path close on the transport barrier —
+        # there is no compiled multi-round program to fuse
+        print(
+            "warning: --fuse_rounds covers the compiled simulator "
+            "round loop and is inert under --role (deploy rounds "
+            "close on the transport barrier; docs/PERFORMANCE.md "
+            "'Round fusion')",
             file=sys.stderr,
         )
     if a.repetitions != 1:
@@ -765,6 +793,18 @@ def main(argv=None) -> int:
             f"{cfg.fed.algorithm!r} simulator (adversary injection "
             "covers the FedAvg-family round program: "
             f"{sorted(_ADVERSARY_SIMS)})",
+            file=sys.stderr,
+        )
+    if (cfg.fed.fuse_rounds > 1
+            and cfg.fed.algorithm not in _ADVERSARY_SIMS):
+        # the fused block scans the FedAvg-family round body; other
+        # sims fall back to the per-round loop (the harness warns too,
+        # but say it at launch where the flag was typed)
+        print(
+            f"warning: --fuse_rounds is ignored by the "
+            f"{cfg.fed.algorithm!r} simulator (round fusion covers "
+            "the FedAvg-family compiled round: "
+            f"{sorted(_ADVERSARY_SIMS)}); this run executes per-round",
             file=sys.stderr,
         )
     if (cfg.fed.compress != "none"

@@ -162,6 +162,14 @@ class ShardedFedAvg(FedAvgSim):
             else None
         )
         self._round_fn = jax.jit(self._sharded_round, donate_argnums=(0,))
+        # round fusion (docs/PERFORMANCE.md "Round fusion"): the
+        # inherited _fused_block scans over whatever _round_impl names
+        # — rebinding it here makes the fused block run the shard_map'd
+        # round body, so fuse_rounds composes with the mesh unchanged
+        # (same collectives per iteration, same whole-mesh MFU
+        # denominator from perf.build_sim_perf). Compression is
+        # rejected above, so the block never carries a residual.
+        self._round_impl = self._sharded_round
 
     def set_cohort_size(self, n: int) -> None:
         """Elastic cohort change for the sharded runtime: ``n`` must
@@ -282,6 +290,9 @@ class ShardedFedAvg(FedAvgSim):
             check_vma=False,
         )(*operands)
         return new_state, metrics
+
+    def _round_operand(self):
+        return self.banks
 
     def run_round(self, state):
         if not self._elastic:

@@ -43,11 +43,16 @@ PR 5's zero-weight healed rows (:func:`fedml_tpu.core.elastic
 content-blind; with elastic buckets on, the bucket is additionally the
 power-of-two one, so membership churn stays a compile-cache hit.
 Executables live in a :class:`~fedml_tpu.core.elastic
-.CompiledRoundCache`; nothing is donated on this path (see the
-constructor note — the stacked operands alias nothing model-sized,
-and the threaded actor's host-side round snapshot can zero-copy alias
-the state). The buffer-donation satellite lives in the sim round,
-whose state and residual have exactly one owner.
+.CompiledRoundCache` keyed by the mesh bucket (the cache accepts any
+hashable key for executables that vary on more than shape); nothing
+is donated on this path (see the constructor note —
+the stacked operands alias nothing model-sized, and the threaded
+actor's host-side round snapshot can zero-copy alias the state). The
+buffer-donation satellite lives in the sim round, whose state and
+residual have exactly one owner. Round fusion (docs/PERFORMANCE.md
+"Round fusion") likewise lives in the sims — ``ShardedFedAvg`` scans
+its shard_map'd round; THIS path closes rounds on the transport
+barrier, so there is no multi-round program to fuse.
 """
 
 from __future__ import annotations

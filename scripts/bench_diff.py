@@ -42,7 +42,11 @@ import sys
 _HIGHER = ("rounds/sec", "hit_rate", "% test acc", "accuracy", "acc",
            # async/tier stage (bench --async-bench): emit throughput
            # per fan-in and the headline fan-in scaling ratio
-           "emits/sec", "ratio")
+           "emits/sec", "ratio",
+           # round-fusion stage (bench --fused-bench): the companion
+           # fedavg_mfu_*_fused records — the MFU-recovery acceptance
+           # surface is a tracked value, not a side-field
+           "mfu")
 _LOWER = ("seconds", "ms/round", "s", "ms", "MB/round")
 
 
