@@ -1766,6 +1766,27 @@ def _run_cpu_fallback(args, emit, staged, probe_error: str) -> int:
     return 0
 
 
+#: flag names owned by the run CLI's live-observability plane
+#: (fedml_tpu/experiments/run.py: the SLO engine and the OpenMetrics
+#: exporter). A future bench stage minting its own ``--slo`` would
+#: shadow the runtime semantics with bench-local ones — the operator's
+#: muscle memory ('--slo means an SloSpec') must hold across every
+#: entrypoint, so registering a collision fails loudly at startup.
+RESERVED_RUN_FLAGS = ("--slo", "--metrics_port")
+
+
+def _assert_no_reserved_flags(ap) -> None:
+    taken = {s for act in ap._actions for s in act.option_strings}
+    clash = taken.intersection(RESERVED_RUN_FLAGS)
+    if clash:
+        raise SystemExit(
+            f"bench.py registered reserved flag(s) {sorted(clash)}: "
+            f"these names belong to the run CLI's SLO/export plane "
+            f"(fedml_tpu/experiments/run.py) — rename the bench stage "
+            f"flag"
+        )
+
+
 def main():
     ap = argparse.ArgumentParser(
         description="Plain `python bench.py` (what the driver runs) "
@@ -1845,6 +1866,7 @@ def main():
                          "BENCH artifact instead of nothing "
                          "(docs/PERFORMANCE.md 'Bench "
                          "trustworthiness')")
+    _assert_no_reserved_flags(ap)
     args = ap.parse_args()
 
     # Fail FAST if the device backend cannot come up: a wedged TPU

@@ -119,6 +119,16 @@ echo "== async smoke: root + 2 leaf aggregators + straggler over gRPC =="
 # (docs/FAULT_TOLERANCE.md "Async + tiered worlds")
 JAX_PLATFORMS=cpu python scripts/async_smoke.py "$OUT/async"
 
+echo "== slo smoke: live /metrics + fleet federation + SLO breach over gRPC =="
+# a 1-server + 2-client gRPC world with --metrics_port 0 and two SLOs:
+# mid-run the rank-0 /metrics endpoint must serve parseable OpenMetrics
+# carrying fleet.* aggregates federated from client heartbeats,
+# /statusz must report the live round, and the chaos-delayed slow phase
+# must flip the tight SLO exactly once (ok 1 -> 0 -> 1, one breach
+# transition, breach duration in slo_rank0.json)
+# (docs/OBSERVABILITY.md "Live export and SLOs")
+JAX_PLATFORMS=cpu python scripts/slo_smoke.py "$OUT/slo"
+
 echo "== compress smoke: topk_int8 wire vs dense over gRPC =="
 # the same 1-server + 2-client gRPC world runs dense and under
 # --compress topk_int8: the per-type byte counters must show >=4x on

@@ -136,6 +136,22 @@ class AsyncFedAvgServerActor(FedAvgServerActor):
         if checkpointer is not None:
             self._restore_async(checkpointer)
 
+    def status(self) -> dict:
+        """``/statusz``: the sync snapshot plus the async plane —
+        buffer fold/version occupancy, parked members, restore state
+        (docs/OBSERVABILITY.md "Live export and SLOs")."""
+        st = super().status()
+        with self._lock:
+            st["async"] = {
+                "buffer_k": self._acfg.buffer_k,
+                "buffer_count": self._buffer.count,
+                "version": self._buffer.version,
+                "folds": self._folds,
+                "parked": sorted(self._parked),
+                "restored_folds": self.restored_folds,
+            }
+        return st
+
     # -- checkpoint (docs/FAULT_TOLERANCE.md "Async + tiered worlds") ------
 
     def _restore_async(self, ckpt) -> None:
@@ -632,6 +648,15 @@ class TierAggregatorActor(FedAvgServerActor):
             MSG_TYPE_FINISH, self.on_root_finish
         )
 
+    def status(self) -> dict:
+        st = super().status()
+        st["tier"] = {
+            "role": "leaf",
+            "client_base": self._client_base,
+            "partials_sent": self.partials_sent,
+        }
+        return st
+
     def _sample(self) -> np.ndarray:
         """A leaf's clients train a contiguous block of global client
         ids anchored at ``client_base`` — sibling leaves cover
@@ -775,6 +800,20 @@ class _PartialRootMixin:
         self.register_message_receive_handler(
             MSG_TYPE_C2S_RESULT, self._reject_direct_result
         )
+
+    def status(self) -> dict:
+        st = super().status()
+        st["tier"] = {
+            "role": "root",
+            "n_leaves": self.tier_spec.n_leaves,
+            "partials_folded": telemetry.METRICS.counter(
+                "tier.partial_sums"
+            ),
+            "partials_rejected": telemetry.METRICS.counter(
+                "tier.partial_rejected"
+            ),
+        }
+        return st
 
     def _reject_direct_result(self, msg: Message) -> None:
         telemetry.METRICS.inc("tier.direct_results_rejected")

@@ -27,6 +27,7 @@ from fedml_tpu.config import ExperimentConfig
 from fedml_tpu.core import adversary as A
 from fedml_tpu.core import compress as CMP
 from fedml_tpu.core import elastic as E
+from fedml_tpu.core import export as EXPORT
 from fedml_tpu.core import robust, telemetry
 from fedml_tpu.core import tree as T
 from fedml_tpu.core.membership import MembershipLedger
@@ -403,6 +404,36 @@ class FedAvgServerActor(ServerManager):
             MSG_TYPE_C2S_LEAVE,
             lambda msg: self.on_peer_leave(msg.sender),
         )
+        # live run introspection (core/export.py ``/statusz``): the
+        # actor is a WEAKLY-held status source — registration costs
+        # nothing while the exporter is off, and a dead actor is
+        # pruned at snapshot time instead of being kept alive
+        EXPORT.register_status_source("server", self)
+
+    def status(self) -> dict:
+        """One ``/statusz`` snapshot: scalars copied under the
+        existing round lock (briefly), membership/quarantine read from
+        their own thread-safe planes — no new lock is held across
+        serialization (the HTTP handler json-encodes the returned
+        plain dict outside every lock)."""
+        with self._lock:
+            pending = len(self._results)
+            dead = sorted(self.dead_peers)
+            failure = self.failure
+            round_idx = self.round_idx
+        mem = self._ledger.summary()
+        return {
+            "actor": type(self).__name__,
+            "round": round_idx,
+            "num_rounds": self.cfg.fed.num_rounds,
+            "results_pending": pending,
+            "membership": {k: len(v) for k, v in mem.items()},
+            "quarantined": self._reputation.quarantined(),
+            "dead_peers": dead,
+            "resumed_from": self.resumed_from,
+            "done": self.done.is_set(),
+            "failure": failure,
+        }
 
     @property
     def variables(self):
@@ -1166,8 +1197,11 @@ class FedAvgServerActor(ServerManager):
             m.gauge("defense.anomaly_score_max",
                     float(diag["score"].max()))
             for r in ranks:
-                m.gauge(f"defense.score_rank{r}",
-                        self._reputation.score(r))
+                # label-capped family: a 10k-client cohort folds ranks
+                # beyond the cap into defense.score_rank.other instead
+                # of growing the registry per peer
+                m.gauge_labeled("defense.score_rank", str(r),
+                                self._reputation.score(r), sep="")
         if events["released"]:
             telemetry.RECORDER.record(
                 "quarantine_released", round=closed_idx,
@@ -1207,7 +1241,13 @@ class FedAvgServerActor(ServerManager):
             tr.log_round_end(closed_idx)
         m = telemetry.METRICS
         if m.enabled:
-            m.observe("round.wall_s", time.monotonic() - self._round_t0)
+            wall = time.monotonic() - self._round_t0
+            m.observe("round.wall_s", wall)
+            # the SLO surface (core/slo.py, docs/OBSERVABILITY.md "Live
+            # export and SLOs"): the deploy server shares the sims'
+            # perf.round_wall_s histogram name, so one --slo spec
+            # covers both drivers
+            m.observe("perf.round_wall_s", wall)
             m.gauge("round.results", len(results))
             if n_live is not None and n_live > len(results):
                 # live workers whose results the deadline cut out
@@ -1375,6 +1415,7 @@ class FedAvgClientActor(ClientManager):
         # budget), and a supervisor sees a clean exit
         self.leave_after_round = leave_after_round
         self.left = threading.Event()
+        self.last_round = -1  # last round this rank worked (/statusz)
         self.arrays, batch = arrays_and_batch(data, cfg.data)
         max_n = self.arrays.max_client_samples
         task = make_task(data.task)
@@ -1424,6 +1465,16 @@ class FedAvgClientActor(ClientManager):
         self.register_message_receive_handler(
             MSG_TYPE_S2C_WELCOME, self._handle_sync
         )
+        EXPORT.register_status_source("client", self)
+
+    def status(self) -> dict:
+        """The client rank's ``/statusz`` contribution."""
+        return {
+            "actor": type(self).__name__,
+            "rank": self.rank,
+            "last_round": self.last_round,
+            "left": self.left.is_set(),
+        }
 
     def _compress_result(self, synced_vars, new_vars,
                          round_idx: int) -> dict:
@@ -1469,8 +1520,10 @@ class FedAvgClientActor(ClientManager):
         return wire
 
     def _handle_sync(self, msg: Message) -> None:
+        t0 = time.monotonic()
         client_idx = int(msg.get(KEY_CLIENT_INDEX))
         round_idx = int(msg.get(KEY_ROUND))
+        self.last_round = round_idx
         variables = jax.tree.map(jnp.asarray, msg.get(KEY_MODEL_PARAMS))
         rng = jax.random.fold_in(
             jax.random.fold_in(self.root_key, round_idx), client_idx
@@ -1520,6 +1573,14 @@ class FedAvgClientActor(ClientManager):
                 },
             )
         )
+        m = telemetry.METRICS
+        if m.enabled:
+            # the client's own round wall (sync received -> result
+            # shipped): the fleet-federation whitelist forwards this
+            # histogram's bucket deltas on the heartbeat uplink, so
+            # rank 0's fleet.perf.round_wall_s answers "p95 client
+            # round time across the cohort" from one scrape
+            m.observe("perf.round_wall_s", time.monotonic() - t0)
         if (self.leave_after_round is not None
                 and round_idx >= self.leave_after_round):
             # contribute this round's result, THEN depart gracefully:

@@ -176,6 +176,15 @@ class FedConfig:
     # the unfused loop's. 1 (default) keeps the per-round loop
     # byte-identical; simulator paths only (FedAvgSim/ShardedFedAvg).
     fuse_rounds: int = 1
+    # declarative SLOs (core/slo.py, docs/OBSERVABILITY.md "Live
+    # export and SLOs"): repeatable --slo specs like
+    # "perf.round_wall_s:p99<2.0@60s" — metric, statistic, healthy
+    # relation, threshold, evaluation window. The windowed evaluator
+    # rides the metrics time-series cadence, exports slo.* burn
+    # gauges, records one flight event per breach TRANSITION, and
+    # writes slo_rank<r>.json verdicts at shutdown. Empty = no engine,
+    # no per-round work.
+    slos: tuple[str, ...] = ()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -284,6 +293,11 @@ class ExperimentConfig:
                     # json round-trips the adversary rank tuple as a
                     # list; restore for hashability under jit
                     v = tuple(int(r) for r in v)
+                if k == "slos" and isinstance(v, Sequence) \
+                        and not isinstance(v, str):
+                    # json round-trips the SLO spec tuple as a list;
+                    # restore for hashability under jit
+                    v = tuple(str(s) for s in v)
                 kw[k] = v
             return cls(**kw)
 

@@ -1,0 +1,543 @@
+"""Live observability plane: OpenMetrics export, /statusz, fleet federation.
+
+Until now every metric died in a per-rank file (``metrics_rank<r>.jsonl``
+and the at-exit snapshot) readable only after the process exited, and
+the server had no view of its clients' metrics at all. This module is
+the live surface (docs/OBSERVABILITY.md "Live export and SLOs"):
+
+- :class:`MetricsExporter` — a stdlib ``http.server`` daemon thread per
+  rank (``--metrics_port`` / ``telemetry.configure(metrics_port=)``;
+  port 0 binds an ephemeral port; off by default, so the
+  zero-cost-when-off rule holds: no socket is opened and no per-message
+  work is added) serving three endpoints on one listener:
+
+  - ``/metrics`` — Prometheus/OpenMetrics text rendered from
+    ``MetricsRegistry.snapshot()``, with REAL histogram bucket series
+    (cumulative ``_bucket{le="..."}`` + ``_sum``/``_count``), not just
+    the interpolated p50/p95/p99, name-sanitized and ``# TYPE``
+    annotated so a stock Prometheus scrape parses it;
+  - ``/statusz`` — a JSON run-introspection snapshot assembled from
+    registered status sources (the live actors), holding no new locks
+    across serialization;
+  - ``/healthz`` — liveness + a degraded verdict when any status
+    source reports a failure (docs/FAULT_TOLERANCE.md cross-links what
+    "healthy" means mid-recovery).
+
+- **fleet federation** — clients piggyback a compact, delta-encoded,
+  size-bounded metric summary on the existing heartbeat path (a new
+  OPTIONAL ``metrics`` field: old clients simply don't send it, and a
+  malformed field is counted + dropped like any other receive-edge
+  screen). The server folds each summary into fleet-level aggregates
+  under the ``fleet.*`` namespace — per-metric count/sum/min/max plus
+  the registry's fixed power-of-two bucket histogram — so ONE scrape of
+  rank 0 answers "what is the p95 client round time across the cohort"
+  without collecting 10k files. Tier worlds federate leaf→root the
+  same way on the uplink heartbeats (a leaf's ``fleet.*`` aggregates
+  forward with the prefix stripped, so the root's ``fleet.*`` covers
+  the whole subtree).
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import math
+import re
+import threading
+import time
+import weakref
+from typing import Any
+
+from fedml_tpu.core import telemetry
+
+# ---------------------------------------------------------------------------
+# OpenMetrics rendering
+# ---------------------------------------------------------------------------
+
+_NAME_OK = re.compile(r"[^a-zA-Z0-9_:]")
+_SANITIZED: dict[str, str] = {}
+
+
+def sanitize_metric_name(name: str) -> str:
+    """Registry names are dotted; Prometheus names match
+    ``[a-zA-Z_:][a-zA-Z0-9_:]*``. Dots (and anything else illegal)
+    become underscores; a leading digit gets a ``_`` prefix. Cached —
+    the scrape path renders the same names every time."""
+    s = _SANITIZED.get(name)
+    if s is None:
+        s = _NAME_OK.sub("_", name)
+        if not s or s[0].isdigit():
+            s = "_" + s
+        _SANITIZED[name] = s
+    return s
+
+
+def _escape_label(value: str) -> str:
+    return (
+        value.replace("\\", r"\\").replace('"', r"\"")
+        .replace("\n", r"\n")
+    )
+
+
+def _fmt(v: float) -> str:
+    if v != v:
+        return "NaN"
+    if v == float("inf"):
+        return "+Inf"
+    if v == float("-inf"):
+        return "-Inf"
+    return repr(float(v))
+
+
+def render_openmetrics(snapshot: dict[str, Any]) -> str:
+    """Render one registry snapshot as Prometheus text exposition
+    format. Histograms export their REAL power-of-two buckets as the
+    cumulative ``_bucket{le=...}`` series (monotone by construction,
+    terminated by ``+Inf`` == ``_count``) plus ``_sum``/``_count``;
+    the interpolated p50/p95/p99 ride along as gauges under
+    ``<name>_p50`` etc. so dashboards keep the simple form too."""
+    lines: list[str] = []
+    for name in sorted(snapshot.get("counters", {})):
+        v = snapshot["counters"][name]
+        s = sanitize_metric_name(name)
+        lines.append(f"# TYPE {s} counter")
+        lines.append(f"{s} {_fmt(v)}")
+    for name in sorted(snapshot.get("gauges", {})):
+        v = snapshot["gauges"][name]
+        s = sanitize_metric_name(name)
+        lines.append(f"# TYPE {s} gauge")
+        lines.append(f"{s} {_fmt(v)}")
+    for name in sorted(snapshot.get("histograms", {})):
+        h = snapshot["histograms"][name]
+        s = sanitize_metric_name(name)
+        lines.append(f"# TYPE {s} histogram")
+        # registry buckets are "le_2^k" exponent tags; the wire wants
+        # cumulative counts by ascending upper bound
+        items = sorted(
+            (int(k.split("^", 1)[1]), c)
+            for k, c in h.get("buckets", {}).items()
+        )
+        cum = 0
+        for k, c in items:
+            cum += c
+            le = _escape_label(_fmt(2.0 ** k))
+            lines.append(f'{s}_bucket{{le="{le}"}} {cum}')
+        lines.append(f'{s}_bucket{{le="+Inf"}} {h.get("count", 0)}')
+        lines.append(f"{s}_sum {_fmt(h.get('sum', 0.0))}")
+        lines.append(f"{s}_count {h.get('count', 0)}")
+        for p in ("p50", "p95", "p99"):
+            if p in h:
+                lines.append(f"# TYPE {s}_{p} gauge")
+                lines.append(f"{s}_{p} {_fmt(h[p])}")
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# status sources (/statusz, /healthz)
+# ---------------------------------------------------------------------------
+
+# name -> weakref to an object with .status() -> dict. Weak on purpose:
+# a module-global strong ref would keep every actor a test ever built
+# alive forever. Dead refs are skipped and pruned at snapshot time.
+_STATUS_SOURCES: dict[str, "weakref.ref"] = {}
+_RUN_STATE: dict[str, Any] = {}
+_STATUS_LOCK = threading.Lock()
+
+
+def register_status_source(name: str, obj: Any) -> None:
+    """Register a live object exposing ``status() -> dict`` under
+    ``name`` in the ``/statusz`` snapshot (last registration per name
+    wins — a restarted actor supersedes its predecessor)."""
+    with _STATUS_LOCK:
+        _STATUS_SOURCES[name] = weakref.ref(obj)
+
+
+def set_run_state(**fields: Any) -> None:
+    """Cheap run-level fields (current round, run name, ...) for
+    drivers without an actor object — the sim harness round loop."""
+    with _STATUS_LOCK:
+        _RUN_STATE.update(fields)
+
+
+def status_snapshot() -> dict[str, Any]:
+    """The ``/statusz`` document. Each source's ``status()`` builds its
+    dict under the source's OWN existing locks (briefly) and returns
+    plain data; serialization happens out here with no lock held."""
+    with _STATUS_LOCK:
+        sources = dict(_STATUS_SOURCES)
+        run_state = dict(_RUN_STATE)
+    out: dict[str, Any] = {
+        "ts": time.time(),
+        "rank": telemetry.RECORDER.rank,
+    }
+    if run_state:
+        out["run"] = run_state
+    dead = []
+    for name, ref in sources.items():
+        obj = ref()
+        if obj is None:
+            dead.append(name)
+            continue
+        try:
+            out[name] = obj.status()
+        except Exception as err:  # a statusz probe must never crash
+            out[name] = {"error": repr(err)}
+    if dead:
+        with _STATUS_LOCK:
+            for name in dead:
+                if _STATUS_SOURCES.get(name) is not None and \
+                        _STATUS_SOURCES[name]() is None:
+                    del _STATUS_SOURCES[name]
+    slo = telemetry.slo_engine()
+    if slo is not None:
+        out["slo"] = slo.verdicts()
+    return out
+
+
+def health_snapshot() -> tuple[int, dict[str, Any]]:
+    """``/healthz``: 200 while every status source is failure-free, 503
+    once any reports a ``failure`` (a quorum-lost abort, a wedged async
+    world). A server mid-recovery — resumed from a checkpoint, barrier
+    still assembling — is HEALTHY: recovery is the designed path, not a
+    failure (docs/FAULT_TOLERANCE.md)."""
+    status = status_snapshot()
+    failures = {
+        name: src["failure"]
+        for name, src in status.items()
+        if isinstance(src, dict) and src.get("failure")
+    }
+    if failures:
+        return 503, {"status": "degraded", "failures": failures}
+    return 200, {"status": "ok", "rank": status.get("rank", 0)}
+
+
+def reset_status_sources() -> None:
+    with _STATUS_LOCK:
+        _STATUS_SOURCES.clear()
+        _RUN_STATE.clear()
+
+
+# ---------------------------------------------------------------------------
+# the HTTP listener
+# ---------------------------------------------------------------------------
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    # the exporter must never log scrapes to stderr
+    def log_message(self, fmt, *args):  # noqa: A003
+        pass
+
+    def _send(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802
+        path = self.path.split("?", 1)[0]
+        try:
+            if path == "/metrics":
+                body = render_openmetrics(
+                    telemetry.METRICS.snapshot()
+                ).encode()
+                self._send(
+                    200, body,
+                    "text/plain; version=0.0.4; charset=utf-8",
+                )
+            elif path == "/statusz":
+                body = json.dumps(
+                    status_snapshot(), indent=2, default=repr
+                ).encode()
+                self._send(200, body, "application/json")
+            elif path == "/healthz":
+                code, doc = health_snapshot()
+                self._send(
+                    code, json.dumps(doc, default=repr).encode(),
+                    "application/json",
+                )
+            else:
+                self._send(404, b"not found\n", "text/plain")
+        except Exception as err:  # scrape must not kill the server
+            try:
+                self._send(500, repr(err).encode(), "text/plain")
+            except Exception:
+                pass
+
+
+class MetricsExporter:
+    """One daemon-thread HTTP listener per rank. ``port=0`` binds an
+    ephemeral port (read it back from :attr:`port`). The listener only
+    READS the registry at scrape time — it adds zero work to any
+    metric write path.
+
+    The endpoints are UNauthenticated (exporter convention) and
+    ``/statusz`` exposes run introspection — membership, quarantine
+    bans, failure diagnostics. The default bind serves any network
+    peer so a remote Prometheus can scrape; on a shared or untrusted
+    network restrict it with ``--metrics_host 127.0.0.1`` (or front it
+    with your scrape proxy)."""
+
+    def __init__(self, port: int, host: str = "0.0.0.0"):
+        self._server = http.server.ThreadingHTTPServer(
+            (host, port), _Handler
+        )
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name=f"metrics-exporter:{self.port}",
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        try:
+            self._server.shutdown()
+            self._server.server_close()
+        except Exception:
+            pass
+        self._thread.join(timeout=2.0)
+
+
+# ---------------------------------------------------------------------------
+# fleet federation (heartbeat piggyback)
+# ---------------------------------------------------------------------------
+
+FLEET_VERSION = 1
+#: client metrics worth federating (docs/OBSERVABILITY.md "Live export
+#: and SLOs"): round wall + local-step time (histograms — bucket deltas
+#: forward, so the server's fleet percentiles are computed over the
+#: cohort's REAL distribution), WORK-payload wire bytes (counters —
+#: deltas; deliberately the per-type result/sync counters, NOT the
+#: transport totals: heartbeat frames count toward the totals, so
+#: whitelisting those would make every beat's own bytes the "change"
+#: that puts a summary on the next beat — a self-perpetuating payload
+#: on an otherwise idle client), and compress ratio / residual /
+#: staleness lag (gauges — each changed value is one fleet
+#: observation).
+FLEET_HISTS = ("perf.round_wall_s", "perf.local_step_s")
+FLEET_COUNTERS = (
+    "transport.bytes_by_type.c2s_result",
+    "transport.bytes_by_type.s2c_sync_model",
+)
+FLEET_GAUGES = (
+    "compress.ratio",
+    "compress.residual_norm",
+    "async.staleness",
+)
+#: histogram families a summary may carry: the direct whitelist plus
+#: the gauges' fleet twins — a LEAF's fold of its clients' gauge
+#: observations lives as a ``fleet.<gauge>`` histogram, and it must
+#: forward upstream or the root's fleet view silently loses every
+#: gauge-family observation below the leaf tier
+FLEET_HIST_FAMILIES = FLEET_HISTS + FLEET_GAUGES
+#: receive-edge bound: a summary carrying more entries than every
+#: whitelist combined is malformed by construction (size-bounding the
+#: heartbeat payload is what keeps the piggyback safe at 10k clients)
+MAX_FLEET_ENTRIES = 32
+_FLEET_PREFIX = "fleet."
+
+
+def fleet_snapshot(registry) -> dict[str, Any]:
+    """Constant-size registry read of exactly the whitelisted families
+    (bare + fleet.-prefixed) — what the heartbeat path feeds
+    :func:`fleet_summary`, so a beat never pays an O(registry)
+    deep-copy or any percentile interpolation."""
+    both = lambda names: tuple(names) + tuple(
+        _FLEET_PREFIX + n for n in names
+    )
+    return registry.read_selected(
+        counters=both(FLEET_COUNTERS),
+        gauges=FLEET_GAUGES,
+        hists=both(FLEET_HIST_FAMILIES),
+    )
+
+
+def fleet_summary(
+    snapshot: dict[str, Any], prev: dict[str, Any]
+) -> dict[str, Any] | None:
+    """Build one compact delta-encoded summary from a registry
+    snapshot. ``prev`` is this sender's mutable carry (last values
+    already shipped) — entries are emitted only when they CHANGED, so
+    an idle client's heartbeat stays exactly as small as before this
+    feature existed. Returns None when nothing changed.
+
+    A leaf aggregator's own ``fleet.*`` aggregates federate upstream
+    with the prefix stripped, so the root folds them into the same
+    families its direct clients fill.
+
+    Degenerate-topology note: in a SINGLE-process loopback world the
+    "client" and "server" share one registry, so a fold lands in the
+    very snapshot the next beat summarizes — each original observation
+    re-forwards once per beat and the fleet counts grow with run
+    length. Real deployments (and tier worlds) never share a registry
+    across the heartbeat edge; loopback worlds are test rigs where the
+    fleet view is not read for truth."""
+    counters = snapshot.get("counters", {})
+    gauges = snapshot.get("gauges", {})
+    hists = snapshot.get("histograms", {})
+    c_out: dict[str, float] = {}
+    g_out: dict[str, float] = {}
+    h_out: dict[str, dict] = {}
+    for name in FLEET_COUNTERS:
+        for key in (name, _FLEET_PREFIX + name):
+            cur = counters.get(key)
+            if cur is None:
+                continue
+            sent = prev.get(("c", key), 0.0)
+            if cur != sent:
+                # ACCUMULATE at the stripped key: a leaf aggregator
+                # carries BOTH its own counter and the fleet.-prefixed
+                # fold of its clients' — the upstream delta is their
+                # sum, not whichever the loop visited last
+                c_out[_strip(key)] = (
+                    c_out.get(_strip(key), 0.0) + cur - sent
+                )
+                prev[("c", key)] = cur
+    for name in FLEET_GAUGES:
+        cur = gauges.get(name)
+        if cur is not None and cur == cur and prev.get(("g", name)) != cur:
+            g_out[name] = cur
+            prev[("g", name)] = cur
+    for name in FLEET_HIST_FAMILIES:
+        # for the gauge families only the fleet.-prefixed twin can be
+        # a histogram (a leaf's fold of its clients' observations);
+        # the bare name misses hists and is handled by the gauge loop
+        for key in (name, _FLEET_PREFIX + name):
+            h = hists.get(key)
+            if h is None:
+                continue
+            base = prev.get(("h", key))
+            if base is not None and base.get("count") == h.get("count"):
+                continue
+            buckets = dict(h.get("buckets", {}))
+            if base is not None:
+                for bk, bv in base.get("buckets", {}).items():
+                    buckets[bk] = buckets.get(bk, 0) - bv
+                buckets = {k: v for k, v in buckets.items() if v > 0}
+            entry = {
+                "n": h.get("count", 0) - (
+                    base.get("count", 0) if base else 0
+                ),
+                "s": h.get("sum", 0.0) - (
+                    base.get("sum", 0.0) if base else 0.0
+                ),
+                "mn": h.get("min"),
+                "mx": h.get("max"),
+                "b": buckets,
+            }
+            seen = h_out.get(_strip(key))
+            if seen is not None:
+                # same accumulation rule as the counters: a leaf's own
+                # histogram and its folded fleet.* twin MERGE at the
+                # stripped key instead of overwriting each other
+                seen["n"] += entry["n"]
+                seen["s"] += entry["s"]
+                seen["mn"] = min(seen["mn"], entry["mn"])
+                seen["mx"] = max(seen["mx"], entry["mx"])
+                for bk, bv in entry["b"].items():
+                    seen["b"][bk] = seen["b"].get(bk, 0) + bv
+            else:
+                h_out[_strip(key)] = entry
+            prev[("h", key)] = {
+                "count": h.get("count", 0),
+                "sum": h.get("sum", 0.0),
+                "buckets": dict(h.get("buckets", {})),
+            }
+    if not (c_out or g_out or h_out):
+        return None
+    out: dict[str, Any] = {"v": FLEET_VERSION}
+    if c_out:
+        out["c"] = c_out
+    if g_out:
+        out["g"] = g_out
+    if h_out:
+        out["h"] = h_out
+    return out
+
+
+def _strip(name: str) -> str:
+    return name[len(_FLEET_PREFIX):] if name.startswith(
+        _FLEET_PREFIX) else name
+
+
+def _finite(v) -> bool:
+    return isinstance(v, (int, float)) and math.isfinite(v)
+
+
+def fold_fleet(payload: Any, registry=None) -> bool:
+    """Receive-edge fold of one heartbeat summary into the ``fleet.*``
+    aggregates. Version-tolerant (unknown version: ignored — a newer
+    client against an older server degrades to plain heartbeats) and
+    chaos-protected: any malformed shape is counted
+    ``fleet.rejected`` and dropped — a poisoned heartbeat must never
+    corrupt the fleet view. Returns True when the summary was folded."""
+    m = registry if registry is not None else telemetry.METRICS
+    if not m.enabled:
+        return False
+    if not isinstance(payload, dict):
+        m.inc("fleet.rejected")
+        return False
+    if payload.get("v") != FLEET_VERSION:
+        m.inc("fleet.version_skipped")
+        return False
+    c = payload.get("c", {})
+    g = payload.get("g", {})
+    h = payload.get("h", {})
+    if not (isinstance(c, dict) and isinstance(g, dict)
+            and isinstance(h, dict)):
+        m.inc("fleet.rejected")
+        return False
+    if len(c) + len(g) + len(h) > MAX_FLEET_ENTRIES:
+        m.inc("fleet.rejected")
+        return False
+    try:
+        for name, delta in c.items():
+            if name not in FLEET_COUNTERS or not _finite(delta) \
+                    or delta < 0:
+                raise ValueError(name)
+        for name, value in g.items():
+            if name not in FLEET_GAUGES or not _finite(value):
+                raise ValueError(name)
+        folds: list[tuple[str, dict]] = []
+        for name, hd in h.items():
+            if name not in FLEET_HIST_FAMILIES \
+                    or not isinstance(hd, dict):
+                raise ValueError(name)
+            n = hd.get("n")
+            s = hd.get("s")
+            b = hd.get("b", {})
+            if not (_finite(n) and n >= 0 and _finite(s)
+                    and isinstance(b, dict)):
+                raise ValueError(name)
+            buckets = {}
+            for bk, bv in b.items():
+                k = int(str(bk).split("^", 1)[1])
+                if not (-20 <= k <= 20) or not _finite(bv) or bv < 0:
+                    raise ValueError(name)
+                buckets[f"le_2^{k}"] = int(bv)
+            if sum(buckets.values()) != int(n):
+                # every registry observation lands in exactly one
+                # bucket, so an honest summary's bucket deltas sum to
+                # its count delta — a mismatch (e.g. n=0 with occupied
+                # buckets) would fold a NON-MONOTONE histogram into
+                # the /metrics exposition
+                raise ValueError(name)
+            mn, mx = hd.get("mn"), hd.get("mx")
+            if int(n) > 0 and not (_finite(mn) and _finite(mx)):
+                raise ValueError(name)
+            folds.append((name, {
+                "count": int(n), "sum": float(s),
+                "min": mn, "max": mx, "buckets": buckets,
+            }))
+    except (ValueError, TypeError, AttributeError, IndexError):
+        m.inc("fleet.rejected")
+        return False
+    for name, delta in c.items():
+        m.inc(_FLEET_PREFIX + name, float(delta))
+    for name, value in g.items():
+        m.observe(_FLEET_PREFIX + name, float(value))
+    for name, hd in folds:
+        m.merge_histogram(_FLEET_PREFIX + name, hd)
+    m.inc("fleet.heartbeat_summaries")
+    return True

@@ -63,6 +63,11 @@ class LivenessMonitor:
         self._gen: dict[int, int] = {p: 0 for p in self.peers}
         self._lock = threading.Lock()
         self._stop = threading.Event()
+        # fleet-federation carry (core/export.py): per-peer record of
+        # the metric values already shipped, so each uplink beat sends
+        # only DELTAS. Best-effort — a beat lost on a flapping link
+        # under-counts the fleet view by one delta, never corrupts it.
+        self._fleet_prev: dict[int, dict] = {}
         mgr.transport.add_deliver_hook(self._on_deliver)
         # ONE thread per peer: a beat to a dead peer blocks inside the
         # transport's retry budget, and a shared loop would let a single
@@ -128,9 +133,27 @@ class LivenessMonitor:
             try:
                 # hb_ts: the peer's manager echoes it back so the next
                 # inbound beat closes the loop into an RTT gauge
+                payload = {"hb_ts": time.monotonic()}
+                # fleet federation (docs/OBSERVABILITY.md "Live export
+                # and SLOs"): an UPLINK beat (this rank -> its rank-0
+                # aggregator) piggybacks a compact delta-encoded metric
+                # summary. The field is optional by design — old
+                # clients simply don't send it — and absent whenever
+                # telemetry is off (the zero-cost-when-off rule) or
+                # nothing changed since the last beat.
+                if peer == 0 and self.mgr.rank != 0 \
+                        and telemetry.METRICS.enabled:
+                    from fedml_tpu.core import export as _export
+
+                    summary = _export.fleet_summary(
+                        _export.fleet_snapshot(telemetry.METRICS),
+                        self._fleet_prev.setdefault(peer, {}),
+                    )
+                    if summary is not None:
+                        payload["metrics"] = summary
                 self.mgr.send_message(
                     Message(MSG_TYPE_HEARTBEAT, self.mgr.rank, peer,
-                            {"hb_ts": time.monotonic()})
+                            payload)
                 )
             except Exception:
                 # endpoint gone (socket transports raise once the
@@ -308,11 +331,24 @@ class Manager:
         ``hb_ts``, so the exchange terminates after one hop."""
         hb_echo = msg.get("hb_echo")
         if hb_echo is not None:
-            telemetry.METRICS.gauge(
-                f"manager.heartbeat_rtt_s.peer{msg.sender}",
+            # cardinality-capped per-peer family: beyond the cap new
+            # peers fold into manager.heartbeat_rtt_s.other instead of
+            # minting one gauge per peer forever (the 10k-client
+            # registry/scrape bound, docs/OBSERVABILITY.md)
+            telemetry.METRICS.gauge_labeled(
+                "manager.heartbeat_rtt_s", f"peer{msg.sender}",
                 time.monotonic() - float(hb_echo),
             )
             return
+        fleet = msg.get("metrics")
+        if fleet is not None and self.rank == 0 \
+                and telemetry.METRICS.enabled:
+            # fold the piggybacked client summary into the fleet.*
+            # aggregates (chaos-protected: malformed fields are counted
+            # and dropped at this receive edge, core/export.py)
+            from fedml_tpu.core import export as _export
+
+            _export.fold_fleet(fleet)
         hb_ts = msg.get("hb_ts")
         if hb_ts is not None:
             try:

@@ -116,12 +116,23 @@ class MetricsRegistry:
     attribute check.
     """
 
-    def __init__(self, enabled: bool = True):
+    #: default per-family label cardinality cap (see
+    #: :meth:`gauge_labeled`): at the 10k-client scale the per-peer
+    #: gauge families (`heartbeat_rtt_s.peer<r>`, `inbox_hwm.rank<r>`)
+    #: would otherwise grow the registry — and every scrape and
+    #: ``snapshot()`` — without bound.
+    LABEL_CAP = 64
+
+    def __init__(self, enabled: bool = True,
+                 label_cap: int = LABEL_CAP):
         self.enabled = enabled
+        self.label_cap = label_cap
         self._lock = threading.Lock()
         self._counters: dict[str, float] = {}
         self._gauges: dict[str, float] = {}
         self._hists: dict[str, dict[str, Any]] = {}
+        # family -> labels already minted (gauge_labeled's cap ledger)
+        self._label_families: dict[str, set[str]] = {}
 
     def inc(self, name: str, value: float = 1) -> None:
         if not self.enabled:
@@ -134,6 +145,52 @@ class MetricsRegistry:
             return
         with self._lock:
             self._gauges[name] = float(value)
+
+    def gauge_labeled(self, family: str, label: str, value: float,
+                      sep: str = ".") -> None:
+        """Per-peer/per-rank gauge families with a cardinality cap
+        (docs/OBSERVABILITY.md "Live export and SLOs"): the first
+        ``label_cap`` distinct labels of a family mint real gauges
+        (``<family><sep><label>``, e.g.
+        ``manager.heartbeat_rtt_s.peer3``); every label beyond the cap
+        folds into ONE ``<family>.other`` overflow gauge and counts
+        ``telemetry.label_overflow`` — so a 10k-peer world keeps its
+        registry (and every scrape) bounded while the overflow stays
+        visible instead of silently dropped."""
+        if not self.enabled:
+            return
+        with self._lock:
+            labels = self._label_families.get(family)
+            if labels is None:
+                labels = self._label_families[family] = set()
+            if label in labels or len(labels) < self.label_cap:
+                labels.add(label)
+                self._gauges[f"{family}{sep}{label}"] = float(value)
+            else:
+                self._gauges[f"{family}.other"] = float(value)
+                self._counters["telemetry.label_overflow"] = (
+                    self._counters.get("telemetry.label_overflow", 0) + 1
+                )
+
+    def labeled_name(self, family: str, label: str,
+                     sep: str = ".") -> str:
+        """Resolve (and register) a labeled gauge's FINAL name once —
+        for per-message hot paths that cache the returned string and
+        then write with plain :meth:`gauge`, keeping the deliver edge
+        allocation-free while the family still honors the cardinality
+        cap. An over-cap label resolves to the ``<family>.other``
+        overflow slot (counted once, at resolution)."""
+        with self._lock:
+            labels = self._label_families.get(family)
+            if labels is None:
+                labels = self._label_families[family] = set()
+            if label in labels or len(labels) < self.label_cap:
+                labels.add(label)
+                return f"{family}{sep}{label}"
+            self._counters["telemetry.label_overflow"] = (
+                self._counters.get("telemetry.label_overflow", 0) + 1
+            )
+            return f"{family}.other"
 
     def observe(self, name: str, value: float) -> None:
         if not self.enabled:
@@ -158,9 +215,69 @@ class MetricsRegistry:
             key = f"le_2^{k}"
             h["buckets"][key] = h["buckets"].get(key, 0) + 1
 
+    def merge_histogram(self, name: str, h: dict[str, Any]) -> None:
+        """Fold a REMOTE histogram delta (count/sum/min/max + bucket
+        deltas in the registry's own ``le_2^k`` keying) into a local
+        histogram — the fleet-federation fold (core/export.py): a
+        client's heartbeat forwards its bucket deltas, and the server's
+        ``fleet.*`` percentiles are computed over the cohort's real
+        distribution, not a summary of summaries."""
+        if not self.enabled:
+            return
+        count = int(h.get("count", 0))
+        buckets = h.get("buckets", {})
+        if count <= 0 and not buckets:
+            return
+        with self._lock:
+            dst = self._hists.get(name)
+            if dst is None:
+                dst = self._hists[name] = {
+                    "count": 0, "sum": 0.0,
+                    "min": float("inf"), "max": float("-inf"),
+                    "buckets": {},
+                }
+            dst["count"] += count
+            dst["sum"] += float(h.get("sum", 0.0))
+            mn, mx = h.get("min"), h.get("max")
+            if mn is not None:
+                dst["min"] = min(dst["min"], float(mn))
+            if mx is not None:
+                dst["max"] = max(dst["max"], float(mx))
+            for k, v in buckets.items():
+                dst["buckets"][k] = dst["buckets"].get(k, 0) + int(v)
+
     def counter(self, name: str) -> float:
         with self._lock:
             return self._counters.get(name, 0)
+
+    def read_selected(
+        self, counters=(), gauges=(), hists=()
+    ) -> dict[str, Any]:
+        """Targeted, constant-size read of named families — the
+        heartbeat fleet-summary path uses this instead of
+        :meth:`snapshot`, which deep-copies the WHOLE registry and
+        interpolates percentiles for every histogram under the lock on
+        every beat. Histogram entries carry the raw shape only (no
+        percentiles — the summary ships bucket deltas, not
+        estimates)."""
+        with self._lock:
+            return {
+                "counters": {
+                    k: self._counters[k] for k in counters
+                    if k in self._counters
+                },
+                "gauges": {
+                    k: self._gauges[k] for k in gauges
+                    if k in self._gauges
+                },
+                "histograms": {
+                    k: {
+                        **self._hists[k],
+                        "buckets": dict(self._hists[k]["buckets"]),
+                    }
+                    for k in hists if k in self._hists
+                },
+            }
 
     def snapshot(self) -> dict[str, Any]:
         """Deep-ish copy safe to mutate / serialize. Histogram entries
@@ -186,6 +303,7 @@ class MetricsRegistry:
             self._counters.clear()
             self._gauges.clear()
             self._hists.clear()
+            self._label_families.clear()
 
 
 class FlightRecorder:
@@ -263,6 +381,22 @@ _RANK = 0
 # SLOs over time instead of only an at-exit snapshot
 _TS_STOP: threading.Event | None = None
 _TS_THREAD: threading.Thread | None = None
+# whether the periodic thread APPENDS jsonl rows (an operator asked for
+# --metrics_interval) or only ticks the SLO engine (the cadence was
+# derived from --slo windows — a long-lived server must not get tens of
+# MB of time series it never asked for as a side effect of an SLO)
+_TS_ROWS = True
+# serializes time-series appends: the periodic flusher and the at-exit
+# final row must never interleave a partial JSONL line (the shutdown
+# path additionally JOINS the flusher before appending the final row,
+# so the file always ends on the end-state snapshot)
+_TS_LOCK = threading.Lock()
+# the live observability plane (core/export.py, core/slo.py): the
+# OpenMetrics HTTP exporter and the SLO engine — both None until
+# configure(metrics_port=...) / configure(slos=...), so the default
+# path opens no socket and evaluates nothing
+_EXPORTER = None
+_SLO = None
 # incarnation suffix ("" for a rank's first process; "_i<n>" for a
 # supervised restart, chosen in configure() so a restarted rank never
 # overwrites the artifacts its predecessor flushed —
@@ -334,6 +468,17 @@ def rank_tag() -> str:
     return f"rank{_RANK}{_SUFFIX}"
 
 
+def slo_engine():
+    """The process SLO engine (None unless ``configure(slos=...)``) —
+    read by the ``/statusz`` assembler (core/export.py)."""
+    return _SLO
+
+
+def exporter():
+    """The process OpenMetrics exporter (None while disabled)."""
+    return _EXPORTER
+
+
 def configure(
     telemetry_dir: str | None = None,
     rank: int = 0,
@@ -341,6 +486,10 @@ def configure(
     jax_profiler: bool = False,
     flight_capacity: int = 1024,
     metrics_interval: float | None = None,
+    metrics_port: int | None = None,
+    metrics_host: str = "0.0.0.0",
+    slos=(),
+    slo_scope: str = "",
 ) -> None:
     """Enable telemetry for THIS process (idempotent).
 
@@ -354,9 +503,22 @@ def configure(
       ``metrics_rank<r>.json``;
     - ``metrics_interval`` (seconds, with a dir) starts the periodic
       time-series flush: append-only ``metrics_rank<r>.jsonl`` rows
-      (:func:`start_metrics_timeseries`).
+      (:func:`start_metrics_timeseries`);
+    - ``metrics_port`` starts the OpenMetrics HTTP exporter
+      (core/export.py: ``/metrics`` + ``/statusz`` + ``/healthz`` on
+      one listener; 0 binds an ephemeral port, read back from
+      ``telemetry.exporter().port`` / the ``telemetry.metrics_port``
+      gauge / ``export_rank<r>.json``). None (the default) opens no
+      socket and adds no work anywhere;
+    - ``slos`` (``--slo`` strings, core/slo.py) arms the SLO engine;
+      its windowed evaluation rides the time-series cadence (a default
+      tick interval is derived from the tightest window when
+      ``metrics_interval`` is not set), exports ``slo.*`` burn gauges,
+      and writes ``slo_rank<r>.json`` verdicts at shutdown.
+      ``slo_scope`` names the job the verdicts belong to (defaults to
+      ``rank<r>``).
     """
-    global TRACER, _DIR, _RANK, _SUFFIX
+    global TRACER, _DIR, _RANK, _SUFFIX, _EXPORTER, _SLO
     _RANK = rank
     METRICS.enabled = True
     RECORDER.rank = rank
@@ -395,8 +557,43 @@ def configure(
             RECORDER._ring, maxlen=flight_capacity
         )
         _install_hooks()
-        if metrics_interval:
-            start_metrics_timeseries(metrics_interval)
+    if slos and _SLO is None:
+        from fedml_tpu.core import slo as _slo_mod
+
+        specs = _slo_mod.parse_specs(
+            slos, scope=slo_scope or f"rank{rank}"
+        )
+        if specs:
+            _SLO = _slo_mod.SloEngine(specs, METRICS, recorder=RECORDER)
+    if metrics_port is not None and _EXPORTER is None:
+        from fedml_tpu.core import export as _export
+
+        _EXPORTER = _export.MetricsExporter(metrics_port,
+                                            host=metrics_host)
+        METRICS.gauge("telemetry.metrics_port", _EXPORTER.port)
+        if _DIR is not None:
+            # port discovery for ephemeral binds (--metrics_port 0):
+            # scrapers read the bound port from the artifact dir
+            try:
+                with open(os.path.join(
+                        _DIR, f"export_rank{_RANK}{_SUFFIX}.json"),
+                        "w") as f:
+                    json.dump(
+                        {"port": _EXPORTER.port, "rank": rank}, f
+                    )
+            except OSError:
+                pass
+    if metrics_interval:
+        start_metrics_timeseries(metrics_interval)
+    elif _SLO is not None and _TS_THREAD is None:
+        # the SLO engine rides the time-series cadence; without an
+        # explicit interval, derive one from the tightest window so
+        # every window sees several evaluations — but tick-only
+        # (rows=False): an SLO must not start a jsonl time series the
+        # operator never asked for
+        w = min(s.window_s for s in _SLO.specs)
+        start_metrics_timeseries(max(0.1, min(1.0, w / 5.0)),
+                                 rows=False)
 
 
 def _timeseries_path() -> str | None:
@@ -424,13 +621,31 @@ def _append_timeseries_row() -> None:
         },
     }
     try:
-        with open(path, "a") as f:
+        # one serialized append per row: the periodic flusher and the
+        # at-exit final row must never interleave partial lines
+        with _TS_LOCK, open(path, "a") as f:
             f.write(json.dumps(row, default=repr) + "\n")
     except OSError:
         pass
 
 
-def start_metrics_timeseries(interval_s: float) -> None:
+def _ts_tick() -> None:
+    """One time-series beat: evaluate the SLO engine (it rides this
+    cadence by design), then — when the operator asked for a time
+    series — append the snapshot row, so every row already carries the
+    fresh ``slo.*`` burn gauges."""
+    slo = _SLO
+    if slo is not None:
+        try:
+            slo.tick()
+        except Exception:
+            pass  # a broken spec must not kill the flusher
+    if _TS_ROWS:
+        _append_timeseries_row()
+
+
+def start_metrics_timeseries(interval_s: float,
+                             rows: bool = True) -> None:
     """Start the periodic metrics flush for this process (idempotent;
     needs a configured telemetry dir). Every ``interval_s`` seconds a
     snapshot row — counters, gauges, histograms with their
@@ -438,15 +653,23 @@ def start_metrics_timeseries(interval_s: float) -> None:
     long-lived deployment's round-latency SLO is a time series, not
     only the at-exit state (the ``.json`` snapshot stays the
     latest-state artifact). The thread is a daemon and dies with the
-    process; :func:`shutdown` stops it and writes one final row."""
-    global _TS_STOP, _TS_THREAD
-    if _DIR is None or interval_s <= 0 or _TS_THREAD is not None:
+    process; :func:`shutdown` stops it and writes one final row. With
+    an SLO engine configured but no telemetry dir, the thread still
+    runs (the engine's windowed ticks ride this cadence) — the row
+    append itself stays dir-gated. ``rows=False`` runs the cadence for
+    the SLO engine ONLY, appending nothing: the derived-from-``--slo``
+    tick must not flood a long-lived server's disk with a time series
+    the operator never asked for."""
+    global _TS_STOP, _TS_THREAD, _TS_ROWS
+    if (_DIR is None and _SLO is None) or interval_s <= 0 \
+            or _TS_THREAD is not None:
         return
+    _TS_ROWS = rows
     stop = threading.Event()
 
     def loop():
         while not stop.wait(interval_s):
-            _append_timeseries_row()
+            _ts_tick()
 
     t = threading.Thread(target=loop, daemon=True,
                          name="metrics-timeseries")
@@ -469,31 +692,65 @@ def flush_metrics() -> None:
     os.replace(tmp, path)
 
 
+def _stop_timeseries(write_final: bool) -> None:
+    """Stop + JOIN the periodic flusher, then (optionally) append ONE
+    final row. The join-before-append ordering is the fix for the
+    shutdown race: a fast exit used to let the daemon's in-flight row
+    interleave with the final one; now the final row is always the
+    file's last line, written after the flusher is provably gone.
+    Idempotent — a second flush appends nothing."""
+    global _TS_STOP, _TS_THREAD
+    stop, thread = _TS_STOP, _TS_THREAD
+    _TS_STOP = _TS_THREAD = None
+    if stop is not None:
+        stop.set()
+    if thread is not None:
+        thread.join(timeout=2.0)
+        if write_final:
+            _ts_tick()
+
+
 def flush() -> None:
     """Write the per-rank trace dump and metrics snapshot now (also runs
     at interpreter exit once a telemetry dir is configured). With the
-    time-series flush armed, one final row is appended too — the tail
-    of the series always reflects the end state."""
+    time-series flush armed, the flusher is joined first and exactly one
+    final row is appended — the tail of the series always reflects the
+    end state, and a fast exit cannot interleave a partial row with it.
+    SLO verdicts (``slo_rank<r>.json``) are written here too."""
     if _DIR is None:
         return
     if TRACER is not None and TRACER.events:
         TRACER.dump(
             os.path.join(_DIR, f"trace_rank{_RANK}{_SUFFIX}.json")
         )
-    if _TS_THREAD is not None:
-        _append_timeseries_row()
+    _stop_timeseries(write_final=True)
+    if _SLO is not None:
+        try:
+            _SLO.write_verdicts(
+                os.path.join(_DIR, f"slo_rank{_RANK}{_SUFFIX}.json"),
+                rank=_RANK,
+            )
+        except Exception:
+            pass  # the verdict artifact must never block the flush
     flush_metrics()
 
 
 def shutdown() -> None:
     """Flush, then return to the all-disabled state (test isolation)."""
-    global TRACER, _DIR, _SUFFIX, _TS_STOP, _TS_THREAD
-    if _TS_STOP is not None:
-        _TS_STOP.set()
-        if _TS_THREAD is not None:
-            _TS_THREAD.join(timeout=2.0)
+    global TRACER, _DIR, _SUFFIX, _EXPORTER, _SLO, _TS_ROWS
+    _stop_timeseries(write_final=_DIR is not None)
     flush()
-    _TS_STOP = _TS_THREAD = None
+    _TS_ROWS = True
+    if _EXPORTER is not None:
+        _EXPORTER.stop()
+        _EXPORTER = None
+    _SLO = None
+    try:
+        from fedml_tpu.core import export as _export
+
+        _export.reset_status_sources()
+    except Exception:
+        pass
     METRICS.enabled = False
     METRICS.reset()
     RECORDER.enabled = False

@@ -124,10 +124,17 @@ class BaseTransport(abc.ABC):
         # while the dispatch thread is busy inside a long handler (a
         # client mid-local-update would otherwise look dead to itself)
         self._deliver_hooks: list[Callable[[Message], None]] = []
-        # precomputed so the enabled hot path allocates no per-message
-        # strings (docs/OBSERVABILITY.md vocabulary)
-        self._inbox_gauge = f"transport.inbox_depth.rank{rank}"
-        self._hwm_gauge = f"manager.inbox_hwm.rank{rank}"
+        # gauge names resolved ONCE through the registry's label-capped
+        # families (a 10k-rank world folds ranks beyond the cap into
+        # one `...other` overflow gauge instead of growing the registry
+        # and every scrape forever), then CACHED so the enabled
+        # per-message hot path allocates no strings and takes no
+        # label-ledger lock — resolution is lazy because the cap
+        # decision belongs to the registry that is live at first use,
+        # not whichever was live at construction
+        self._inbox_label = f"rank{rank}"
+        self._depth_gauge: str | None = None
+        self._hwm_gauge: str | None = None
 
     # -- to implement ------------------------------------------------------
     @abc.abstractmethod
@@ -187,7 +194,12 @@ class BaseTransport(abc.ABC):
                     )
             m = telemetry.METRICS
             if m.enabled:
-                m.gauge(self._inbox_gauge, self._inbox.qsize())
+                name = self._depth_gauge
+                if name is None:
+                    name = self._depth_gauge = m.labeled_name(
+                        "transport.inbox_depth", self._inbox_label
+                    )
+                m.gauge(name, self._inbox.qsize())
         for hook in self._deliver_hooks:
             hook(msg)
         shed = self._inbox.put(msg)
@@ -202,7 +214,12 @@ class BaseTransport(abc.ABC):
             # The shed counter is additive, so a shared name is fine.
             m = telemetry.METRICS
             if m.enabled:
-                m.gauge(self._hwm_gauge, self._inbox.hwm)
+                name = self._hwm_gauge
+                if name is None:
+                    name = self._hwm_gauge = m.labeled_name(
+                        "manager.inbox_hwm", self._inbox_label
+                    )
+                m.gauge(name, self._inbox.hwm)
                 if shed:
                     m.inc("manager.inbox_shed")
 

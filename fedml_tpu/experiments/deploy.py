@@ -155,6 +155,14 @@ class DeployConfig:
     # SLO surface of a long-lived server — histograms carry p50/p95/p99
     # — docs/OBSERVABILITY.md "Performance observability")
     metrics_interval: float | None = None
+    # live OpenMetrics exporter (core/export.py, docs/OBSERVABILITY.md
+    # "Live export and SLOs"): serve /metrics + /statusz + /healthz on
+    # this port (0 = ephemeral; None = no socket, the default — the
+    # zero-cost-when-off rule). SLO specs ride FedConfig.slos. The
+    # endpoints are unauthenticated; metrics_host restricts the bind
+    # (default any-interface so a remote Prometheus can scrape).
+    metrics_port: int | None = None
+    metrics_host: str = "0.0.0.0"
 
 
 def load_ip_config(path: str) -> dict[int, tuple[str, int]]:
@@ -769,6 +777,13 @@ def _run_fedavg_rank(cfg: ExperimentConfig, dep: DeployConfig) -> dict:
             "async_restored_folds": getattr(server, "restored_folds",
                                             0),
             "tier_spec": dep.tier_spec,
+            # the live-observability plane in force (docs/
+            # OBSERVABILITY.md "Live export and SLOs"): the SLO specs
+            # evaluated this run (verdicts in slo_rank<r>.json) and
+            # the exporter's bound port (None = no listener)
+            "slos": list(cfg.fed.slos),
+            "metrics_port": getattr(telemetry.exporter(), "port",
+                                    None),
             **metrics,
         }
 
@@ -1250,7 +1265,8 @@ class Supervisor:
 def run_role(cfg: ExperimentConfig, dep: DeployConfig) -> dict:
     """Run THIS process's rank to completion; returns the rank summary."""
     if (dep.telemetry_dir or dep.trace or dep.trace_jax
-            or dep.metrics_interval):
+            or dep.metrics_interval or dep.metrics_port is not None
+            or cfg.fed.slos):
         telemetry.configure(
             # --trace without a dir still gets dumps, in the run dir
             telemetry_dir=dep.telemetry_dir
@@ -1258,6 +1274,10 @@ def run_role(cfg: ExperimentConfig, dep: DeployConfig) -> dict:
             rank=dep.rank,
             jax_profiler=dep.trace_jax,
             metrics_interval=dep.metrics_interval,
+            metrics_port=dep.metrics_port,
+            metrics_host=dep.metrics_host,
+            slos=cfg.fed.slos,
+            slo_scope=cfg.run_name,
         )
     algo = cfg.fed.algorithm
     if algo in FEDAVG_FAMILY:

@@ -449,6 +449,16 @@ class Experiment:
             )
         for r in range(start_round, cfg.fed.num_rounds):
             t0 = _time.perf_counter()
+            if telemetry.METRICS.enabled:
+                # /statusz "run" block (core/export.py): the sim loop
+                # has no actor to register, so the live round rides
+                # the cheap run-state dict instead
+                from fedml_tpu.core import export as _export
+
+                _export.set_run_state(
+                    round=r, num_rounds=cfg.fed.num_rounds,
+                    run_name=cfg.run_name,
+                )
             if profiler is not None:
                 profiler.start_round(r)
             with telemetry.maybe_span("sim_round", round=r):
@@ -544,6 +554,13 @@ class Experiment:
             return records
 
         def boundary_hook(r_last, last):
+            if telemetry.METRICS.enabled:
+                from fedml_tpu.core import export as _export
+
+                _export.set_run_state(
+                    round=r_last, num_rounds=total,
+                    run_name=cfg.run_name,
+                )
             if (r_last + 1) % cfg.fed.eval_every == 0 or (
                 r_last == total - 1
             ):

@@ -265,6 +265,39 @@ def parse_args(argv=None) -> tuple[ExperimentConfig, argparse.Namespace]:
                         "telemetry dir (round-latency SLO time "
                         "series: histograms carry p50/p95/p99); "
                         "implies telemetry")
+    # -- live observability plane (core/export.py, core/slo.py;
+    # docs/OBSERVABILITY.md "Live export and SLOs") -------------------------
+    p.add_argument("--metrics_port", type=int, default=None,
+                   help="serve THIS rank's live metrics over HTTP: "
+                        "/metrics (OpenMetrics text a stock Prometheus "
+                        "scrape parses, with real histogram buckets "
+                        "and the fleet.* aggregates federated from "
+                        "client heartbeats), /statusz (JSON run "
+                        "introspection: round, membership, async "
+                        "buffer, SLO verdicts), /healthz — all on one "
+                        "stdlib listener. 0 binds an ephemeral port "
+                        "(read it back from export_rank<r>.json in "
+                        "the telemetry dir); unset (default) opens no "
+                        "socket. Implies telemetry")
+    p.add_argument("--metrics_host", type=str, default="0.0.0.0",
+                   help="interface the metrics listener binds "
+                        "(default 0.0.0.0 so a remote Prometheus can "
+                        "scrape; the endpoints are unauthenticated "
+                        "and /statusz exposes run introspection — on "
+                        "a shared network bind 127.0.0.1)")
+    p.add_argument("--slo", action="append", default=None,
+                   metavar="SPEC",
+                   help="declarative SLO (repeatable), e.g. "
+                        "'perf.round_wall_s:p99<2.0@60s': metric, "
+                        "statistic (p50/p95/p99/mean/max/min over the "
+                        "window, 'value' for gauges, 'rate' for "
+                        "counters), healthy relation, threshold, "
+                        "window. Evaluated on the metrics time-series "
+                        "cadence; exports slo.ok/slo.breach_seconds/"
+                        "slo.burn_rate gauges, records ONE flight "
+                        "event per breach transition, and writes "
+                        "slo_rank<r>.json verdicts at shutdown. "
+                        "Implies telemetry")
     # -- process-separated deployment (reference mpirun/run_server.sh
     # surface: one OS process per rank; scripts/run_distributed.sh is the
     # localhost launcher) --------------------------------------------------
@@ -407,6 +440,7 @@ def parse_args(argv=None) -> tuple[ExperimentConfig, argparse.Namespace]:
             shard_aggregation=True if a.shard_aggregation else None,
             profile_rounds=a.profile_rounds,
             fuse_rounds=a.fuse_rounds,
+            slos=tuple(a.slo) if a.slo else None,
         ),
         adversary=rep(
             cfg.adversary,
@@ -446,6 +480,16 @@ def parse_args(argv=None) -> tuple[ExperimentConfig, argparse.Namespace]:
                          evict_after=a.quarantine_evict_after)
         check_fednova_compat(cfg.fed.algorithm, cfg.fed.robust_method)
         AsyncConfig.from_fed(cfg.fed)
+        if cfg.fed.slos:
+            from fedml_tpu.core.slo import parse_specs
+
+            parse_specs(cfg.fed.slos)
+        if a.metrics_port is not None and not (
+                0 <= a.metrics_port < 65536):
+            raise ValueError(
+                f"--metrics_port must be in [0, 65535] (0 = "
+                f"ephemeral), got {a.metrics_port}"
+            )
         if a.tier_spec is not None:
             TierSpec.parse(a.tier_spec)
         from fedml_tpu.algorithms.async_actors import check_async_compat
@@ -595,6 +639,8 @@ def _deploy_config(a) -> "DeployConfig":
         trace=a.trace,
         trace_jax=a.trace_jax,
         metrics_interval=a.metrics_interval,
+        metrics_port=a.metrics_port,
+        metrics_host=a.metrics_host,
         backend=a.backend,
         ip_config=load_ip_config(a.ip_config) if a.ip_config else None,
         broker=broker,
@@ -693,6 +739,11 @@ def _run_supervised(a, argv: list[str]) -> int:
     base = _strip_flags(argv, bare={"--supervise"},
                         valued={"--max_restarts"})
     clean = _strip_flags(base, prefixes=("--fault_",))
+    # --metrics_port names ONE port: the server keeps it (its /metrics
+    # carries the federated fleet.* view anyway); clients would all
+    # collide on the same bind, so the flag is stripped from their argv
+    c_base = _strip_flags(base, valued={"--metrics_port"})
+    c_clean = _strip_flags(clean, valued={"--metrics_port"})
     entry = [sys.executable, "-m", "fedml_tpu.experiments.run"]
     specs = [
         RankSpec(
@@ -705,9 +756,9 @@ def _run_supervised(a, argv: list[str]) -> int:
         specs.append(
             RankSpec(
                 rank=r,
-                argv=[*entry, *base, "--role", "client",
+                argv=[*entry, *c_base, "--role", "client",
                       "--rank", str(r)],
-                restart_argv=[*entry, *clean, "--role", "client",
+                restart_argv=[*entry, *c_clean, "--role", "client",
                               "--rank", str(r)],
             )
         )
@@ -820,7 +871,8 @@ def main(argv=None) -> int:
             file=sys.stderr,
         )
     if (a.telemetry_dir or a.trace or a.trace_jax
-            or cfg.fed.profile_rounds or a.metrics_interval):
+            or cfg.fed.profile_rounds or a.metrics_interval
+            or a.metrics_port is not None or cfg.fed.slos):
         from fedml_tpu.core import telemetry
 
         telemetry.configure(
@@ -829,6 +881,10 @@ def main(argv=None) -> int:
             rank=0,
             jax_profiler=a.trace_jax,
             metrics_interval=a.metrics_interval,
+            metrics_port=a.metrics_port,
+            metrics_host=a.metrics_host,
+            slos=cfg.fed.slos,
+            slo_scope=cfg.run_name,
         )
     summaries = Experiment(cfg, a.repetitions).run()
     for s in summaries:

@@ -1,0 +1,291 @@
+"""CI smoke: live export + SLO engine over a real gRPC world.
+
+Drives the live observability plane end to end (docs/OBSERVABILITY.md
+"Live export and SLOs"): a 1-server + 2-client gRPC world runs with
+``--metrics_port 0`` and two SLOs —
+
+- a LOOSE one (``perf.round_wall_s:p99<30@5s``) that must never breach;
+- a TIGHT one (``perf.round_wall_s:p99<0.3@2s``) that the induced slow
+  phase must breach EXACTLY ONCE: client 2 runs under a seeded chaos
+  delay (every message +0.005..0.8 s) for its whole stay and LEAVEs
+  gracefully after round 3, so rounds 0..3 are slow (round 0's client
+  jit compile adds more), every later round is fast, and the tight
+  SLO's ok gauge flips 1 -> 0 -> 1 with one breach transition and a
+  recorded breach duration.
+
+Mid-run the script scrapes rank 0's ephemeral ``/metrics`` endpoint
+(port discovered from ``export_rank0.json``) and asserts the exposition
+parses — ``# TYPE`` lines, monotone cumulative buckets — and carries
+``fleet.*`` aggregates federated from the clients' heartbeat
+piggybacks; ``/statusz`` must report the live round and ``/healthz``
+must be 200. After the run, ``slo_rank0.json`` must hold the verdicts:
+loose ok with zero transitions, tight ok with exactly two transitions
+(breach + recovery) and breach_seconds > 0 — and the metrics
+time-series must show exactly one contiguous breached block.
+
+Usage::
+
+    python scripts/slo_smoke.py OUT_DIR
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ROUNDS = 300
+LEAVE_AFTER = 3
+TIGHT = "perf.round_wall_s:p99<0.3@2s"
+LOOSE = "perf.round_wall_s:p99<30@5s"
+
+
+def _free_ports(n):
+    socks = [socket.socket() for _ in range(n)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _scrape(port, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=10
+    ) as r:
+        return r.status, r.read().decode()
+
+
+def _check_exposition(text):
+    """Minimal strict checks mirroring tests/test_export.py's parser:
+    every sample's family has a # TYPE line; every histogram's bucket
+    series is cumulative-monotone and +Inf-terminated."""
+    types, buckets = {}, {}
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            name, kind = line[len("# TYPE "):].split(" ", 1)
+            types[name] = kind
+            continue
+        assert not line.startswith("#"), f"unknown comment: {line!r}"
+        name, _, value = line.partition(" ")
+        base = name.split("{", 1)[0]
+        fam = base
+        for suffix in ("_bucket", "_sum", "_count", "_p50", "_p95",
+                       "_p99"):
+            if base.endswith(suffix) and base[:-len(suffix)] in types:
+                fam = base[:-len(suffix)]
+        assert fam in types, f"sample {name!r} has no # TYPE"
+        if base.endswith("_bucket"):
+            le = name.split('le="', 1)[1].split('"', 1)[0]
+            buckets.setdefault(base, []).append(
+                (float("inf") if le == "+Inf" else float(le),
+                 float(value))
+            )
+    # (an early scrape may legitimately predate any histogram; bucket
+    # SHAPE is validated whenever buckets are present, and the accept
+    # loop below only finishes once the fleet histogram exists)
+    for name, series in buckets.items():
+        les = [le for le, _ in series]
+        counts = [c for _, c in series]
+        assert les == sorted(les), f"{name} out of order"
+        assert counts == sorted(counts), f"{name} not cumulative"
+        assert les[-1] == float("inf"), f"{name} missing +Inf"
+    return types
+
+
+def main(out_dir: str) -> int:
+    os.makedirs(out_dir, exist_ok=True)
+    cfg = {
+        "data": {"dataset": "fake_mnist", "num_clients": 2,
+                 "batch_size": 32, "partition_method": "homo",
+                 "seed": 0},
+        "model": {"name": "lr", "num_classes": 10,
+                  "input_shape": [28, 28, 1]},
+        "train": {"lr": 0.1, "epochs": 1},
+        "fed": {"algorithm": "fedavg", "num_rounds": ROUNDS,
+                "clients_per_round": 2, "eval_every": ROUNDS},
+        "seed": 0,
+        "run_name": "slo",
+        "out_dir": out_dir,
+    }
+    cfg_path = os.path.join(out_dir, "cfg.json")
+    with open(cfg_path, "w") as f:
+        json.dump(cfg, f)
+    ports = _free_ports(3)
+    ip_path = os.path.join(out_dir, "ip.json")
+    with open(ip_path, "w") as f:
+        json.dump({str(r): ["127.0.0.1", ports[r]] for r in range(3)},
+                  f)
+    telemetry_dir = os.path.join(out_dir, "telemetry")
+    base = [sys.executable, "-m", "fedml_tpu.experiments.run",
+            "--config", cfg_path, "--backend", "grpc",
+            "--world_size", "3", "--ip_config", ip_path,
+            "--ready_timeout", "120",
+            "--telemetry_dir", telemetry_dir,
+            "--metrics_interval", "0.1",
+            "--heartbeat_interval", "0.5", "--heartbeat_timeout", "30",
+            "--quorum_fraction", "0.5", "--round_deadline", "120"]
+    env = _env()
+
+    def spawn(role, rank=None, extra=()):
+        argv = [*base, "--role", role, *extra]
+        if rank is not None:
+            argv += ["--rank", str(rank)]
+        return subprocess.Popen(argv, env=env, cwd=REPO,
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT, text=True)
+
+    procs = {
+        # client 1 carries a small pacing delay (5..30 ms per message):
+        # fast rounds stay far below the tight threshold while giving
+        # the post-breach tail enough wall time to drain the window
+        1: spawn("client", 1, extra=("--fault_delay", "1.0",
+                                     "--fault_delay_max", "0.03")),
+        # client 2 is the induced slow phase: every message +up to
+        # 0.8 s, then a graceful LEAVE — after it departs every round
+        # is fast and the tight SLO must recover
+        2: spawn("client", 2, extra=("--fault_delay", "1.0",
+                                     "--fault_delay_max", "0.8",
+                                     "--leave_after_round",
+                                     str(LEAVE_AFTER))),
+    }
+    server = spawn("server", extra=("--metrics_port", "0",
+                                    "--slo", TIGHT, "--slo", LOOSE))
+
+    # -- discover the ephemeral port, scrape mid-run -----------------------
+    export_path = os.path.join(telemetry_dir, "export_rank0.json")
+    deadline = time.monotonic() + 240
+    port = None
+    while port is None and time.monotonic() < deadline:
+        if server.poll() is not None:
+            out = server.communicate()[0]
+            for p in procs.values():
+                p.kill()
+            raise SystemExit(
+                f"server exited rc={server.returncode} before the "
+                f"exporter came up:\n{out}"
+            )
+        if os.path.exists(export_path):
+            with open(export_path) as f:
+                port = json.load(f)["port"]
+        time.sleep(0.05)
+    if port is None:
+        server.kill()
+        for p in procs.values():
+            p.kill()
+        raise SystemExit("export_rank0.json never appeared")
+
+    # the fleet aggregates need at least one client heartbeat summary;
+    # poll the live endpoint until they land (mid-run by construction:
+    # the run lasts hundreds of rounds)
+    fleet_seen = live_round = None
+    slo_block = healthz = None
+    while time.monotonic() < deadline and server.poll() is None:
+        code, metrics_text = _scrape(port, "/metrics")
+        assert code == 200
+        types = _check_exposition(metrics_text)
+        code, statusz_text = _scrape(port, "/statusz")
+        assert code == 200
+        statusz = json.loads(statusz_text)
+        if "server" in statusz:
+            live_round = statusz["server"]["round"]
+        slo_block = statusz.get("slo")
+        code, hz = _scrape(port, "/healthz")
+        healthz = (code, json.loads(hz))
+        if ("fleet_perf_round_wall_s" in types
+                and "perf_round_wall_s" in types
+                and live_round is not None):
+            fleet_seen = types["fleet_perf_round_wall_s"]
+            break
+        time.sleep(0.2)
+    assert fleet_seen == "histogram", (
+        f"fleet.* client aggregates never appeared on /metrics "
+        f"(types: {sorted(t for t in (types or {}))})"
+    )
+    assert "perf_round_wall_s" in types, sorted(types)
+    assert live_round is not None and live_round >= 0
+    assert slo_block and {s["metric"] for s in slo_block} == {
+        "perf.round_wall_s"
+    }, slo_block
+    assert healthz[0] == 200 and healthz[1]["status"] == "ok", healthz
+
+    # -- wind down ---------------------------------------------------------
+    s_out = server.communicate(timeout=600)[0]
+    outs = {}
+    for r, p in procs.items():
+        try:
+            outs[r] = p.communicate(timeout=60)[0]
+        except subprocess.TimeoutExpired:
+            p.kill()
+            outs[r] = p.communicate()[0]
+    if server.returncode != 0:
+        raise SystemExit(
+            f"server failed rc={server.returncode}:\n{s_out}"
+        )
+    summary = json.loads(s_out.strip().splitlines()[-1])
+    assert summary["rounds"] == ROUNDS, summary
+    assert summary["membership"]["left"] == [2], summary
+
+    # -- the SLO verdicts --------------------------------------------------
+    with open(os.path.join(telemetry_dir, "slo_rank0.json")) as f:
+        verdicts = {v["slo"]: v for v in json.load(f)["slos"]}
+    tight = next(v for k, v in verdicts.items() if "0.3" in k)
+    loose = next(v for k, v in verdicts.items() if "30" in k)
+    assert loose["ok"] and loose["transitions"] == 0, loose
+    assert tight["ok"], tight
+    # exactly one breach TRANSITION (and its recovery)
+    assert tight["transitions"] == 2, tight
+    assert tight["breach_seconds"] > 0, tight
+
+    # -- slo.ok 1 -> 0 -> 1, exactly one contiguous breached block ---------
+    key = None
+    series = []
+    with open(os.path.join(telemetry_dir,
+                           "metrics_rank0.jsonl")) as f:
+        for line in f:
+            row = json.loads(line)
+            if key is None:
+                key = next((k for k in row.get("gauges", {})
+                            if k.startswith("slo.ok.")
+                            and row["gauges"][k] is not None), None)
+            if key and key in row.get("gauges", {}):
+                series.append(row["gauges"][key])
+    # collapse consecutive duplicates: the tight SLO's trajectory must
+    # be exactly one breached block — [1,0,1] (or [0,1] when the first
+    # tick already saw the slow phase)
+    dedup = [series[0]] if series else []
+    for v in series[1:]:
+        if v != dedup[-1]:
+            dedup.append(v)
+    assert dedup in ([1.0, 0.0, 1.0], [0.0, 1.0]), dedup
+
+    print(json.dumps({
+        "slo_smoke": "ok",
+        "rounds": summary["rounds"],
+        "live_round_at_scrape": live_round,
+        "tight": {"transitions": tight["transitions"],
+                  "breach_seconds": tight["breach_seconds"]},
+        "ok_trajectory": dedup,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) != 2:
+        raise SystemExit("usage: slo_smoke.py OUT_DIR")
+    sys.exit(main(sys.argv[1]))

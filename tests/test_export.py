@@ -1,0 +1,966 @@
+"""Live observability plane suite (docs/OBSERVABILITY.md "Live export
+and SLOs"): OpenMetrics export, fleet federation, the SLO engine, the
+label-cardinality cap, and the time-series shutdown ordering.
+
+The pins, in dependency order:
+
+1.  OpenMetrics rendering passes a STRICT in-test parser: every sample
+    is preceded by its ``# TYPE`` line, names are Prometheus-legal,
+    histogram buckets are cumulative-monotone and terminated by
+    ``+Inf == _count``, label values escape quotes/backslashes;
+2.  the exporter serves /metrics, /statusz and /healthz over real HTTP
+    on one listener (port 0 = ephemeral) — and DISABLED (the default)
+    it opens no socket and adds zero registry work;
+3.  fleet federation: the fold math is pinned against hand
+    computation; a loopback heartbeat world piggybacks summaries that
+    land as ``fleet.*`` aggregates; an old client's beat (no
+    ``metrics`` field) is ignored; a malformed field is counted +
+    dropped; version skew degrades to plain heartbeats;
+4.  SloSpec parse/reject table; the engine flips ok 1 -> 0 -> 1 across
+    a breach with exactly ONE flight event per transition (never one
+    per tick), accumulates breach_seconds, and writes the
+    ``slo_rank<r>.json`` verdict artifact;
+5.  per-peer gauge families are capped: a 500-peer churn holds the
+    registry flat with the overflow aggregate + counter observed;
+6.  the time-series flusher is joined before the final row, so every
+    line of a sub-interval run's JSONL parses and the final row is the
+    file's last line;
+7.  /statusz schema under the sync, async, and tier actors.
+"""
+
+import json
+import re
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from fedml_tpu.config import (
+    DataConfig,
+    ExperimentConfig,
+    FedConfig,
+    ModelConfig,
+    TrainConfig,
+)
+from fedml_tpu.core import export, slo, telemetry
+from fedml_tpu.core.manager import Manager
+from fedml_tpu.core.message import MSG_TYPE_HEARTBEAT, Message
+from fedml_tpu.core.telemetry import MetricsRegistry
+from fedml_tpu.core.transport.loopback import LoopbackHub
+
+
+@pytest.fixture
+def metrics_on():
+    telemetry.METRICS.enabled = True
+    telemetry.METRICS.reset()
+    yield telemetry.METRICS
+    telemetry.METRICS.enabled = False
+    telemetry.METRICS.reset()
+    export.reset_status_sources()
+
+
+def _cfg(rounds=2, **fed_kw):
+    return ExperimentConfig(
+        data=DataConfig(dataset="fake_mnist", num_clients=2,
+                        batch_size=32, seed=0),
+        model=ModelConfig(name="lr", num_classes=10,
+                          input_shape=(28, 28, 1)),
+        train=TrainConfig(lr=0.1, epochs=1),
+        fed=FedConfig(num_rounds=rounds, clients_per_round=2,
+                      eval_every=rounds, **fed_kw),
+        seed=0,
+    )
+
+
+# ---------------------------------------------------------------------------
+# strict OpenMetrics parser (the test's own, so the renderer can't
+# grade its own homework)
+# ---------------------------------------------------------------------------
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?"
+    r" (?P<value>[^ ]+)$"
+)
+
+
+def strict_parse(text: str) -> dict:
+    """Parse Prometheus text exposition format STRICTLY: unknown line
+    shapes fail, every sample's base family must have a # TYPE, bucket
+    series must be cumulative-monotone and +Inf-terminated matching
+    _count."""
+    types: dict[str, str] = {}
+    samples: dict[str, list] = {}
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, kind = rest.partition(" ")
+            assert _NAME_RE.match(name), f"illegal metric name {name!r}"
+            assert kind in ("counter", "gauge", "histogram"), line
+            assert name not in types, f"duplicate TYPE for {name}"
+            types[name] = kind
+            continue
+        assert not line.startswith("#"), f"unknown comment line {line!r}"
+        m = _SAMPLE_RE.match(line)
+        assert m, f"unparseable sample line {line!r}"
+        name = m.group("name")
+        labels = {}
+        if m.group("labels"):
+            for pair in re.findall(
+                r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"',
+                m.group("labels"),
+            ):
+                labels[pair[0]] = pair[1]
+        value = m.group("value")
+        v = float("inf") if value == "+Inf" else float(value)
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[: -len(suffix)] in types:
+                base = name[: -len(suffix)]
+        assert base in types, f"sample {name!r} has no # TYPE"
+        samples.setdefault(name, []).append((labels, v))
+    # histogram shape: cumulative monotone buckets, +Inf == _count
+    for name, kind in types.items():
+        if kind != "histogram":
+            continue
+        buckets = samples.get(f"{name}_bucket", [])
+        assert buckets, f"histogram {name} has no buckets"
+        les, counts = [], []
+        for labels, v in buckets:
+            assert "le" in labels, f"{name}_bucket missing le"
+            le = labels["le"]
+            les.append(float("inf") if le == "+Inf" else float(le))
+            counts.append(v)
+        assert les == sorted(les), f"{name} buckets out of order"
+        assert les[-1] == float("inf"), f"{name} missing +Inf bucket"
+        assert counts == sorted(counts), f"{name} not cumulative"
+        (_, count), = samples[f"{name}_count"]
+        assert counts[-1] == count, f"{name} +Inf != _count"
+        assert f"{name}_sum" in samples
+    return {"types": types, "samples": samples}
+
+
+# ---------------------------------------------------------------------------
+# 1. rendering
+# ---------------------------------------------------------------------------
+
+
+def test_openmetrics_rendering_passes_strict_parser():
+    reg = MetricsRegistry()
+    reg.inc("transport.bytes_sent", 1234)
+    reg.inc("round.quorum_lost_aborts")
+    reg.gauge("perf.mfu", 0.128)
+    reg.gauge("weird.name-with%chars", 1.0)
+    for v in (0.1, 0.2, 0.4, 1.5, 3.0, 0.05):
+        reg.observe("perf.round_wall_s", v)
+    out = strict_parse(export.render_openmetrics(reg.snapshot()))
+    assert out["types"]["transport_bytes_sent"] == "counter"
+    assert out["types"]["perf_mfu"] == "gauge"
+    assert out["types"]["perf_round_wall_s"] == "histogram"
+    # dotted / illegal chars sanitized, value preserved
+    assert out["samples"]["weird_name_with_chars"][0][1] == 1.0
+    (_, count), = out["samples"]["perf_round_wall_s_count"]
+    assert count == 6
+    (_, total), = out["samples"]["perf_round_wall_s_sum"]
+    assert abs(total - 5.25) < 1e-9
+    # interpolated percentiles ride along as plain gauges
+    assert "perf_round_wall_s_p99" in out["samples"]
+
+
+def test_openmetrics_name_sanitization_rules():
+    assert export.sanitize_metric_name("a.b.c") == "a_b_c"
+    assert export.sanitize_metric_name("9lives") == "_9lives"
+    assert export.sanitize_metric_name("ok_name") == "ok_name"
+    assert _NAME_RE.match(export.sanitize_metric_name("x y/z%"))
+
+
+def test_openmetrics_empty_snapshot_is_valid():
+    out = strict_parse(export.render_openmetrics(
+        {"counters": {}, "gauges": {}, "histograms": {}}
+    ))
+    assert out["types"] == {} and out["samples"] == {}
+
+
+# ---------------------------------------------------------------------------
+# 2. the HTTP listener
+# ---------------------------------------------------------------------------
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=10
+    ) as r:
+        return r.status, r.read().decode()
+
+
+def test_exporter_serves_all_three_endpoints(metrics_on):
+    metrics_on.observe("perf.round_wall_s", 0.5)
+    metrics_on.inc("transport.bytes_sent", 10)
+    ex = export.MetricsExporter(0)
+    try:
+        assert ex.port > 0
+        code, body = _get(ex.port, "/metrics")
+        assert code == 200
+        out = strict_parse(body)
+        assert "perf_round_wall_s" in out["types"]
+        code, body = _get(ex.port, "/statusz")
+        assert code == 200
+        doc = json.loads(body)
+        assert "ts" in doc and "rank" in doc
+        code, body = _get(ex.port, "/healthz")
+        assert code == 200 and json.loads(body)["status"] == "ok"
+        with pytest.raises(urllib.error.HTTPError):
+            _get(ex.port, "/nope")
+    finally:
+        ex.stop()
+
+
+def test_healthz_degrades_on_source_failure(metrics_on):
+    class Failing:
+        def status(self):
+            return {"failure": "quorum lost at round 3"}
+
+    export.register_status_source("server", Failing())
+    src = Failing()
+    export.register_status_source("server", src)
+    code, doc = export.health_snapshot()
+    assert code == 503 and doc["status"] == "degraded"
+    assert "server" in doc["failures"]
+
+
+def test_exporter_disabled_default_opens_no_socket(tmp_path):
+    """The zero-cost-when-off pin: a plain configure() creates no
+    exporter, no SLO engine, and metric writes mint no export-related
+    registry keys."""
+    telemetry.configure(telemetry_dir=str(tmp_path / "t"), rank=0)
+    try:
+        assert telemetry.exporter() is None
+        assert telemetry.slo_engine() is None
+        telemetry.METRICS.inc("transport.messages_sent")
+        telemetry.METRICS.observe("perf.round_wall_s", 0.1)
+        snap = telemetry.METRICS.snapshot()
+        leaked = [k for ks in snap.values() for k in ks
+                  if k.startswith(("slo.", "fleet.", "telemetry.metrics_port"))]
+        assert leaked == []
+    finally:
+        telemetry.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# 3. fleet federation
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_fold_math_pinned_by_hand(metrics_on):
+    """count/sum/min/max + bucket sums after folding two client
+    summaries must equal the hand computation."""
+    ok = export.fold_fleet({
+        "v": 1,
+        "c": {"transport.bytes_by_type.c2s_result": 100.0},
+        "g": {"compress.ratio": 4.0},
+        "h": {"perf.round_wall_s": {
+            "n": 2, "s": 0.3 + 0.6, "mn": 0.3, "mx": 0.6,
+            "b": {"le_2^-1": 1, "le_2^0": 1},
+        }},
+    })
+    assert ok
+    ok = export.fold_fleet({
+        "v": 1,
+        "c": {"transport.bytes_by_type.c2s_result": 50.0},
+        "h": {"perf.round_wall_s": {
+            "n": 1, "s": 1.2, "mn": 1.2, "mx": 1.2,
+            "b": {"le_2^1": 1},
+        }},
+    })
+    assert ok
+    snap = metrics_on.snapshot()
+    assert snap["counters"][
+        "fleet.transport.bytes_by_type.c2s_result"] == 150.0
+    assert snap["counters"]["fleet.heartbeat_summaries"] == 2
+    h = snap["histograms"]["fleet.perf.round_wall_s"]
+    assert h["count"] == 3
+    assert abs(h["sum"] - 2.1) < 1e-9
+    assert h["min"] == 0.3 and h["max"] == 1.2
+    assert h["buckets"] == {"le_2^-1": 1, "le_2^0": 1, "le_2^1": 1}
+    g = snap["histograms"]["fleet.compress.ratio"]
+    assert g["count"] == 1 and g["min"] == g["max"] == 4.0
+
+
+def test_fleet_summary_is_delta_encoded():
+    prev = {}
+    snap = {
+        "counters": {"transport.bytes_by_type.c2s_result": 100.0},
+        "gauges": {"compress.ratio": 4.0},
+        "histograms": {"perf.round_wall_s": {
+            "count": 1, "sum": 0.5, "min": 0.5, "max": 0.5,
+            "buckets": {"le_2^-1": 1},
+        }},
+    }
+    s1 = export.fleet_summary(snap, prev)
+    assert s1["c"]["transport.bytes_by_type.c2s_result"] == 100.0
+    assert s1["h"]["perf.round_wall_s"]["n"] == 1
+    # nothing changed -> no summary at all (idle beats stay small)
+    assert export.fleet_summary(snap, prev) is None
+    snap["counters"]["transport.bytes_by_type.c2s_result"] = 130.0
+    snap["histograms"]["perf.round_wall_s"] = {
+        "count": 3, "sum": 2.5, "min": 0.5, "max": 1.5,
+        "buckets": {"le_2^-1": 1, "le_2^1": 2},
+    }
+    s2 = export.fleet_summary(snap, prev)
+    assert s2["c"]["transport.bytes_by_type.c2s_result"] == 30.0  # DELTA
+    assert s2["h"]["perf.round_wall_s"]["n"] == 2
+    assert s2["h"]["perf.round_wall_s"]["b"] == {"le_2^1": 2}
+    assert "g" not in s2  # unchanged gauge not resent
+
+
+def test_fleet_fold_rejects_malformed_and_skips_versions(metrics_on):
+    assert not export.fold_fleet("not a dict")
+    assert not export.fold_fleet({"v": 1, "c": {"evil.metric": 5}})
+    assert not export.fold_fleet({"v": 1, "c": {
+        "transport.bytes_by_type.c2s_result": float("nan")}})
+    assert not export.fold_fleet({"v": 1, "h": {"perf.round_wall_s": {
+        "n": 1, "s": 1.0, "mn": 1.0, "mx": 1.0,
+        "b": {"le_2^99": 1},  # out-of-range bucket exponent
+    }}})
+    # oversized payload
+    assert not export.fold_fleet({
+        "v": 1, "g": {f"g{i}": 1.0 for i in range(64)},
+    })
+    snap = metrics_on.snapshot()
+    assert snap["counters"]["fleet.rejected"] == 5
+    # future version: skipped (counted separately), never rejected
+    assert not export.fold_fleet({"v": 99, "c": {}})
+    assert metrics_on.snapshot()["counters"]["fleet.version_skipped"] == 1
+    # nothing leaked into the fleet namespace
+    assert not any(
+        k.startswith("fleet.transport")
+        for k in metrics_on.snapshot()["counters"]
+    )
+    # and the transport TOTALS are deliberately not whitelisted: a
+    # heartbeat's own frame bytes must never be the "change" that puts
+    # a summary on the next beat (self-perpetuating payload)
+    assert "transport.bytes_sent" not in export.FLEET_COUNTERS
+
+
+def test_heartbeat_piggyback_lands_as_fleet_aggregates(metrics_on):
+    """Loopback 2-rank world: rank 1's beats to rank 0 carry the
+    summary; rank 0 folds it into fleet.*."""
+    hub = LoopbackHub()
+    a = Manager(0, 2, hub.create(0))
+    b = Manager(1, 2, hub.create(1))
+    ta = threading.Thread(target=a.run, daemon=True)
+    tb = threading.Thread(target=b.run, daemon=True)
+    ta.start(); tb.start()
+    metrics_on.observe("perf.round_wall_s", 0.3)
+    metrics_on.observe("perf.round_wall_s", 0.7)
+    b.enable_liveness([0], interval_s=0.05, timeout_s=30.0)
+    deadline = time.monotonic() + 10
+    h = None
+    while time.monotonic() < deadline:
+        h = metrics_on.snapshot()["histograms"].get(
+            "fleet.perf.round_wall_s"
+        )
+        if h and h["count"] >= 2:
+            break
+        time.sleep(0.02)
+    assert h is not None and h["count"] >= 2, h
+    assert h["min"] == 0.3 and h["max"] == 0.7
+    c = metrics_on.snapshot()["counters"]
+    assert c.get("fleet.heartbeat_summaries", 0) >= 1
+    assert "fleet.rejected" not in c
+    a.finish(); b.finish()
+    ta.join(timeout=2); tb.join(timeout=2)
+
+
+def test_old_client_heartbeat_without_metrics_is_ignored(metrics_on):
+    """Version tolerance: a bare beat (an old client) folds nothing
+    and breaks nothing."""
+    hub = LoopbackHub()
+    a = Manager(0, 2, hub.create(0))
+    hub.create(1)
+    ta = threading.Thread(target=a.run, daemon=True)
+    ta.start()
+    # hand-built old-style beat: hb_ts only, no metrics field
+    a.transport.deliver(
+        Message(MSG_TYPE_HEARTBEAT, 1, 0, {"hb_ts": time.monotonic()})
+    )
+    time.sleep(0.2)
+    c = metrics_on.snapshot()["counters"]
+    assert "fleet.heartbeat_summaries" not in c
+    assert "fleet.rejected" not in c
+    a.finish(); ta.join(timeout=2)
+
+
+def test_malformed_piggyback_is_counted_and_dropped(metrics_on):
+    hub = LoopbackHub()
+    a = Manager(0, 2, hub.create(0))
+    hub.create(1)
+    ta = threading.Thread(target=a.run, daemon=True)
+    ta.start()
+    a.transport.deliver(Message(
+        MSG_TYPE_HEARTBEAT, 1, 0,
+        {"hb_ts": time.monotonic(), "metrics": ["chaos", "garbage"]},
+    ))
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        if metrics_on.snapshot()["counters"].get("fleet.rejected"):
+            break
+        time.sleep(0.02)
+    assert metrics_on.snapshot()["counters"]["fleet.rejected"] == 1
+    a.finish(); ta.join(timeout=2)
+
+
+# ---------------------------------------------------------------------------
+# 4. SLO specs + engine
+# ---------------------------------------------------------------------------
+
+
+def test_slospec_parse_table():
+    s = slo.SloSpec.parse("perf.round_wall_s:p99<2.0@60s")
+    assert (s.metric, s.stat, s.op, s.threshold, s.window_s) == (
+        "perf.round_wall_s", "p99", "<", 2.0, 60.0
+    )
+    assert slo.SloSpec.parse("x:p50<1@5m").window_s == 300.0
+    assert slo.SloSpec.parse("x:mean<0.5@1h").window_s == 3600.0
+    assert slo.SloSpec.parse("async.buffer_depth:value<100@10s").stat \
+        == "value"
+    assert slo.SloSpec.parse("robust.nonfinite_rejected:rate<0.1@60s")\
+        .stat == "rate"
+    assert slo.SloSpec.parse("perf.mfu:value>0.05@60s").op == ">"
+    # scope carried through
+    assert slo.SloSpec.parse("x:p99<1@5s", scope="job7").scope == "job7"
+
+
+@pytest.mark.parametrize("bad", [
+    "",
+    "perf.round_wall_s",                   # no stat
+    "perf.round_wall_s:p99<2.0",           # no window
+    "perf.round_wall_s:p99<@60s",          # no threshold
+    "perf.round_wall_s:p42<2.0@60s",       # unknown stat
+    "perf.round_wall_s:p99=2.0@60s",       # unsupported relation
+    "perf.round_wall_s:p99<2.0@60y",       # unknown window unit
+    "perf.round_wall_s:p99<2.0@-5s",       # negative window
+    "perf.round_wall_s:p99<nanana@60s",    # non-numeric threshold
+    ":p99<2.0@60s",                        # empty metric
+])
+def test_slospec_reject_table(bad):
+    with pytest.raises(ValueError):
+        slo.SloSpec.parse(bad)
+
+
+class _Rec:
+    def __init__(self):
+        self.events = []
+
+    def record(self, kind, **fields):
+        self.events.append((kind, fields))
+
+
+def _engine(spec_str, reg, clock):
+    rec = _Rec()
+    eng = slo.SloEngine(
+        [slo.SloSpec.parse(spec_str, scope="testjob")], reg,
+        recorder=rec, clock=lambda: clock[0],
+    )
+    return eng, rec
+
+
+def test_slo_breach_cycle_one_event_per_transition(tmp_path):
+    """ok -> breach -> ok: slo.ok 1 -> 0 -> 1, ONE flight event per
+    transition (not per tick), breach_seconds accumulated, verdict
+    artifact written."""
+    reg = MetricsRegistry()
+    clock = [0.0]
+    eng, rec = _engine("lat:p99<1.0@10s", reg, clock)
+    slug = eng.specs[0].slug
+    for _ in range(3):
+        reg.observe("lat", 0.1)
+        eng.tick()
+        clock[0] += 1.0
+    assert reg.snapshot()["gauges"][f"slo.ok.{slug}"] == 1.0
+    reg.observe("lat", 5.0)  # the induced slow round
+    # many ticks while breached: exactly ONE breach event total
+    for _ in range(8):
+        reg.observe("lat", 0.1)
+        eng.tick()
+        clock[0] += 1.0
+    assert reg.snapshot()["gauges"][f"slo.ok.{slug}"] == 0.0
+    breaches = [e for e in rec.events if e[0] == "slo_breach"]
+    assert len(breaches) == 1
+    assert breaches[0][1]["scope"] == "testjob"
+    # keep the traffic flowing until the slow sample ages out
+    for _ in range(8):
+        reg.observe("lat", 0.1)
+        eng.tick()
+        clock[0] += 1.0
+    assert reg.snapshot()["gauges"][f"slo.ok.{slug}"] == 1.0
+    assert len([e for e in rec.events if e[0] == "slo_breach"]) == 1
+    assert len([e for e in rec.events if e[0] == "slo_recovered"]) == 1
+    v = eng.verdicts()[0]
+    assert v["ok"] and v["transitions"] == 2
+    assert v["breach_seconds"] > 0
+    g = reg.snapshot()["gauges"]
+    assert g[f"slo.breach_seconds.{slug}"] == v["breach_seconds"]
+    path = str(tmp_path / "slo_rank0.json")
+    eng.write_verdicts(path, rank=0)
+    doc = json.loads(open(path).read())
+    assert doc["rank"] == 0
+    assert doc["slos"][0]["slo"] == "lat:p99<1.0@10.0s"
+    assert doc["slos"][0]["scope"] == "testjob"
+
+
+def test_slo_gauge_value_and_counter_rate_stats():
+    reg = MetricsRegistry()
+    clock = [0.0]
+    eng, rec = _engine("depth:value<10@5s", reg, clock)
+    slug = eng.specs[0].slug
+    reg.gauge("depth", 3)
+    eng.tick(); clock[0] += 1
+    assert reg.snapshot()["gauges"][f"slo.ok.{slug}"] == 1.0
+    reg.gauge("depth", 50)
+    eng.tick()
+    assert reg.snapshot()["gauges"][f"slo.ok.{slug}"] == 0.0
+
+    reg2 = MetricsRegistry()
+    clock2 = [0.0]
+    eng2, _ = _engine("errs:rate<1.0@10s", reg2, clock2)
+    slug2 = eng2.specs[0].slug
+    for _ in range(12):
+        eng2.tick()
+        clock2[0] += 1.0
+    assert reg2.snapshot()["gauges"][f"slo.ok.{slug2}"] == 1.0
+    reg2.inc("errs", 100)
+    eng2.tick()
+    assert reg2.snapshot()["gauges"][f"slo.ok.{slug2}"] == 0.0
+
+
+def test_slo_no_window_signal_holds_state():
+    """An idle server (no observations inside the window) keeps its
+    previous verdict — silence is not a breach."""
+    reg = MetricsRegistry()
+    clock = [0.0]
+    eng, rec = _engine("lat:p99<1.0@5s", reg, clock)
+    for _ in range(10):
+        eng.tick()
+        clock[0] += 1.0
+    assert eng.verdicts()[0]["ok"]
+    assert rec.events == []
+
+
+def test_parse_specs_dedups_exact_repeats():
+    specs = slo.parse_specs(
+        ["a:p99<1@5s", "a:p99<1@5s", "b:p50<2@5s"], scope="s"
+    )
+    assert len(specs) == 2
+
+
+# ---------------------------------------------------------------------------
+# 5. cardinality cap
+# ---------------------------------------------------------------------------
+
+
+def test_gauge_label_cardinality_cap_500_peer_churn():
+    """The 10k-client protection: 500 peers churning RTT/inbox gauges
+    hold the registry flat at the cap, with the overflow aggregate and
+    counter observed."""
+    reg = MetricsRegistry(label_cap=64)
+    for r in range(500):
+        reg.gauge_labeled("manager.heartbeat_rtt_s", f"peer{r}",
+                          0.001 * r)
+        reg.gauge_labeled("manager.inbox_hwm", f"rank{r}", r)
+    snap = reg.snapshot()
+    rtt = [k for k in snap["gauges"]
+           if k.startswith("manager.heartbeat_rtt_s.")]
+    hwm = [k for k in snap["gauges"]
+           if k.startswith("manager.inbox_hwm.")]
+    assert len(rtt) == 65 and "manager.heartbeat_rtt_s.other" in rtt
+    assert len(hwm) == 65 and "manager.inbox_hwm.other" in hwm
+    assert snap["counters"]["telemetry.label_overflow"] == 2 * (500 - 64)
+    # capped members keep updating in place — registry stays flat
+    before = len(reg.snapshot()["gauges"])
+    for r in range(500):
+        reg.gauge_labeled("manager.heartbeat_rtt_s", f"peer{r}", 0.5)
+    assert len(reg.snapshot()["gauges"]) == before
+    # the in-cap labels still update normally
+    assert reg.snapshot()["gauges"]["manager.heartbeat_rtt_s.peer0"] \
+        == 0.5
+
+
+def test_transport_inbox_gauges_ride_the_capped_family(metrics_on):
+    """500 loopback transports delivering one message each mint at
+    most cap+1 inbox gauges (the live deliver edge, not just the
+    registry API)."""
+    hub = LoopbackHub()
+    transports = [hub.create(r) for r in range(500)]
+    for r, t in enumerate(transports):
+        t.deliver(Message(100, (r + 1) % 500, r, {}))
+    snap = metrics_on.snapshot()
+    hwm = [k for k in snap["gauges"]
+           if k.startswith("manager.inbox_hwm.")]
+    assert len(hwm) <= telemetry.MetricsRegistry.LABEL_CAP + 1
+    assert "manager.inbox_hwm.other" in hwm
+    assert snap["counters"]["telemetry.label_overflow"] > 0
+
+
+def test_defense_score_family_uses_legacy_name():
+    """The capped family keeps the documented defense.score_rank<r>
+    naming for in-cap ranks."""
+    reg = MetricsRegistry(label_cap=4)
+    for r in range(6):
+        reg.gauge_labeled("defense.score_rank", str(r), 0.1, sep="")
+    g = reg.snapshot()["gauges"]
+    assert "defense.score_rank0" in g
+    assert "defense.score_rank.other" in g
+
+
+# ---------------------------------------------------------------------------
+# 6. time-series shutdown ordering
+# ---------------------------------------------------------------------------
+
+
+def test_timeseries_final_row_ordered_after_join(tmp_path):
+    """Sub-interval run: shutdown before the first periodic beat still
+    yields exactly one (final) row; every line parses."""
+    tdir = str(tmp_path / "t")
+    telemetry.configure(telemetry_dir=tdir, rank=0,
+                        metrics_interval=30.0)
+    telemetry.METRICS.inc("c", 3)
+    telemetry.shutdown()
+    rows = [json.loads(line) for line in
+            open(f"{tdir}/metrics_rank0.jsonl")]
+    assert len(rows) == 1
+    assert rows[-1]["counters"]["c"] == 3
+
+
+def test_timeseries_fast_flush_all_rows_parse(tmp_path):
+    """Tiny interval + immediate shutdown: the flusher is joined
+    before the final row, so no partial line can interleave."""
+    tdir = str(tmp_path / "t")
+    telemetry.configure(telemetry_dir=tdir, rank=0,
+                        metrics_interval=0.01)
+    for i in range(50):
+        telemetry.METRICS.inc("c")
+        time.sleep(0.002)
+    telemetry.shutdown()
+    lines = open(f"{tdir}/metrics_rank0.jsonl").read().splitlines()
+    rows = [json.loads(line) for line in lines]  # every line parses
+    assert rows
+    assert rows[-1]["counters"]["c"] == 50  # final row is the end state
+    # a second flush after shutdown appends nothing
+    telemetry.flush()
+    assert len(open(f"{tdir}/metrics_rank0.jsonl").read()
+               .splitlines()) == len(lines)
+
+
+def test_configure_with_slos_writes_verdicts_and_rides_cadence(tmp_path):
+    tdir = str(tmp_path / "t")
+    telemetry.configure(
+        telemetry_dir=tdir, rank=0, metrics_interval=0.05,
+        slos=("perf.round_wall_s:p99<100@2s",), slo_scope="jobx",
+    )
+    try:
+        telemetry.METRICS.observe("perf.round_wall_s", 0.1)
+        slug = telemetry.slo_engine().specs[0].slug
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if f"slo.ok.{slug}" in \
+                    telemetry.METRICS.snapshot()["gauges"]:
+                break
+            time.sleep(0.02)
+        g = telemetry.METRICS.snapshot()["gauges"]
+        assert g.get(f"slo.ok.{slug}") == 1.0
+    finally:
+        telemetry.shutdown()
+    doc = json.loads(open(f"{tdir}/slo_rank0.json").read())
+    assert doc["slos"][0]["ok"] is True
+    assert doc["slos"][0]["scope"] == "jobx"
+
+
+# ---------------------------------------------------------------------------
+# 7. /statusz under the three actor shapes
+# ---------------------------------------------------------------------------
+
+
+def _mk_server(cls=None, cfg=None, hub=None, world=3, **kw):
+    from fedml_tpu.algorithms.distributed_fedavg import FedAvgServerActor
+    from fedml_tpu.data.loaders import load_dataset
+    from fedml_tpu.models import create_model
+
+    cfg = cfg or _cfg()
+    hub = hub or LoopbackHub()
+    data = load_dataset(cfg.data)
+    model = create_model(cfg.model)
+    cls = cls or FedAvgServerActor
+    return cls(world, hub.create(0), model, cfg,
+               num_clients=cfg.data.num_clients, data=data, **kw), hub
+
+
+def test_statusz_schema_sync_actor(metrics_on):
+    server, _ = _mk_server()
+    st = server.status()
+    assert st["actor"] == "FedAvgServerActor"
+    assert st["round"] == 0 and st["num_rounds"] == 2
+    assert set(st["membership"]) >= {"active", "left", "evicted"}
+    assert st["membership"]["active"] == 2
+    assert st["quarantined"] == [] and st["dead_peers"] == []
+    assert st["resumed_from"] == 0 and st["failure"] is None
+    assert st["done"] is False
+    # the registered source feeds the exporter snapshot
+    doc = export.status_snapshot()
+    assert doc["server"]["actor"] == "FedAvgServerActor"
+    json.dumps(doc, default=repr)  # serializable end-to-end
+    server.finish()
+
+
+def test_statusz_schema_async_actor(metrics_on):
+    from fedml_tpu.algorithms.async_actors import AsyncFedAvgServerActor
+
+    server, _ = _mk_server(
+        AsyncFedAvgServerActor, cfg=_cfg(async_buffer_k=2),
+    )
+    st = server.status()
+    assert st["actor"] == "AsyncFedAvgServerActor"
+    a = st["async"]
+    assert a["buffer_k"] == 2 and a["buffer_count"] == 0
+    assert a["version"] == 0 and a["folds"] == 0
+    assert a["parked"] == [] and a["restored_folds"] == 0
+    json.dumps(export.status_snapshot(), default=repr)
+    server.finish()
+
+
+def test_statusz_schema_tier_actors(metrics_on):
+    from fedml_tpu.algorithms.async_actors import (
+        TierAggregatorActor,
+        TierRootActor,
+    )
+    from fedml_tpu.core.tier import TierSpec
+    from fedml_tpu.data.loaders import load_dataset
+    from fedml_tpu.models import create_model
+
+    cfg = _cfg()
+    spec = TierSpec.parse("root:2")
+    root, _ = _mk_server(
+        None, cfg=cfg, world=spec.root_world_size,
+    )
+    root.finish()
+    root_hub = LoopbackHub()
+    data = load_dataset(cfg.data)
+    model = create_model(cfg.model)
+    troot = TierRootActor(
+        spec.root_world_size, root_hub.create(0), model, cfg,
+        num_clients=cfg.data.num_clients, data=data, tier_spec=spec,
+    )
+    st = troot.status()
+    assert st["tier"]["role"] == "root"
+    assert st["tier"]["n_leaves"] == 2
+    assert "partials_folded" in st["tier"]
+    leaf_hub = LoopbackHub()
+    uplink = Manager(1, spec.root_world_size, root_hub.create(1))
+    leaf = TierAggregatorActor(
+        3, leaf_hub.create(0), uplink, model, cfg,
+        client_base=0, num_clients=cfg.data.num_clients, data=data,
+    )
+    st = leaf.status()
+    assert st["tier"]["role"] == "leaf"
+    assert st["tier"]["partials_sent"] == 0
+    assert st["tier"]["client_base"] == 0
+    json.dumps(export.status_snapshot(), default=repr)
+    leaf.finish(); uplink.finish(); troot.finish()
+
+
+def test_statusz_sources_are_weak(metrics_on):
+    import gc
+
+    class Src:
+        def status(self):
+            return {"x": 1}
+
+    s = Src()
+    export.register_status_source("tmp", s)
+    assert export.status_snapshot()["tmp"] == {"x": 1}
+    del s
+    gc.collect()
+    assert "tmp" not in export.status_snapshot()
+
+
+def test_statusz_slo_block_present_when_engine_armed(tmp_path):
+    telemetry.configure(
+        telemetry_dir=str(tmp_path / "t"), rank=0,
+        slos=("perf.round_wall_s:p99<100@5s",),
+    )
+    try:
+        doc = export.status_snapshot()
+        assert doc["slo"][0]["metric"] == "perf.round_wall_s"
+    finally:
+        telemetry.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# review-hardening regressions
+# ---------------------------------------------------------------------------
+
+
+def test_slo_max_stat_is_windowed_and_recovers():
+    """A max-based SLO must recover once the slow observation ages out
+    of the window — the all-time cumulative max must not pin it in
+    breach forever."""
+    reg = MetricsRegistry()
+    clock = [0.0]
+    eng, rec = _engine("lat:max<2.0@10s", reg, clock)
+    reg.observe("lat", 10.0)  # one slow round at t=0
+    eng.tick(); clock[0] += 1.0
+    assert not eng.verdicts()[0]["ok"]
+    for _ in range(20):
+        reg.observe("lat", 0.5)
+        eng.tick()
+        clock[0] += 1.0
+    v = eng.verdicts()[0]
+    assert v["ok"], v  # the 10s observation aged out of the window
+    assert v["transitions"] == 2
+    # windowed max estimate sits in the fast rounds' bucket (<= 2x)
+    assert v["last_value"] <= 1.0, v
+
+
+def test_fleet_summary_merges_bare_and_prefixed_families():
+    """A leaf aggregator's own metric and its folded fleet.* twin must
+    SUM at the stripped key — neither may silently overwrite the
+    other's delta (the tier-world undercount regression)."""
+    prev = {}
+    snap = {
+        "counters": {
+            "transport.bytes_by_type.c2s_result": 1000.0,
+            "fleet.transport.bytes_by_type.c2s_result": 500.0,
+        },
+        "gauges": {},
+        "histograms": {
+            "perf.round_wall_s": {
+                "count": 1, "sum": 0.5, "min": 0.5, "max": 0.5,
+                "buckets": {"le_2^-1": 1},
+            },
+            "fleet.perf.round_wall_s": {
+                "count": 2, "sum": 3.0, "min": 1.0, "max": 2.0,
+                "buckets": {"le_2^0": 1, "le_2^1": 1},
+            },
+        },
+    }
+    s = export.fleet_summary(snap, prev)
+    assert s["c"]["transport.bytes_by_type.c2s_result"] == 1500.0
+    h = s["h"]["perf.round_wall_s"]
+    assert h["n"] == 3 and abs(h["s"] - 3.5) < 1e-9
+    assert h["mn"] == 0.5 and h["mx"] == 2.0
+    assert h["b"] == {"le_2^-1": 1, "le_2^0": 1, "le_2^1": 1}
+    # and the merged summary is itself foldable
+    telemetry.METRICS.enabled = True
+    telemetry.METRICS.reset()
+    try:
+        assert export.fold_fleet({"v": 1, "c": s["c"], "h": s["h"]})
+    finally:
+        telemetry.METRICS.enabled = False
+        telemetry.METRICS.reset()
+
+
+def test_fleet_fold_rejects_bucket_count_mismatch(metrics_on):
+    """n=0 with occupied buckets (or any bucket/count skew) must be
+    rejected — folding it would serve a non-monotone histogram."""
+    assert not export.fold_fleet({"v": 1, "h": {"perf.round_wall_s": {
+        "n": 0, "s": 0.0, "b": {"le_2^0": 5},
+    }}})
+    assert not export.fold_fleet({"v": 1, "h": {"perf.round_wall_s": {
+        "n": 3, "s": 1.0, "mn": 0.1, "mx": 0.9,
+        "b": {"le_2^0": 1},  # buckets sum to 1, not 3
+    }}})
+    assert metrics_on.snapshot()["counters"]["fleet.rejected"] == 2
+    assert "fleet.perf.round_wall_s" not in \
+        metrics_on.snapshot()["histograms"]
+
+
+def test_slo_only_configure_does_not_write_timeseries_rows(tmp_path):
+    """--slo without --metrics_interval must tick the engine on the
+    derived cadence WITHOUT flooding the dir with jsonl rows the
+    operator never asked for."""
+    tdir = str(tmp_path / "t")
+    telemetry.configure(
+        telemetry_dir=tdir, rank=0,
+        slos=("perf.round_wall_s:p99<100@1s",),
+    )
+    try:
+        telemetry.METRICS.observe("perf.round_wall_s", 0.1)
+        deadline = time.monotonic() + 5
+        slug = telemetry.slo_engine().specs[0].slug
+        while time.monotonic() < deadline:
+            if f"slo.ok.{slug}" in \
+                    telemetry.METRICS.snapshot()["gauges"]:
+                break
+            time.sleep(0.02)
+        assert f"slo.ok.{slug}" in \
+            telemetry.METRICS.snapshot()["gauges"]
+    finally:
+        telemetry.shutdown()
+    import os
+    # the engine ticked (gauge present, verdict written) but no
+    # periodic time series was started as a side effect
+    assert os.path.exists(f"{tdir}/slo_rank0.json")
+    assert not os.path.exists(f"{tdir}/metrics_rank0.jsonl")
+
+
+def test_labeled_name_caches_cap_decision():
+    reg = MetricsRegistry(label_cap=2)
+    assert reg.labeled_name("f", "a") == "f.a"
+    assert reg.labeled_name("f", "b") == "f.b"
+    assert reg.labeled_name("f", "c") == "f.other"
+    assert reg.labeled_name("f", "a") == "f.a"  # stable for in-cap
+    assert reg.snapshot()["counters"]["telemetry.label_overflow"] == 1
+
+
+def test_leaf_fleet_gauge_histograms_forward_upstream(metrics_on):
+    """A leaf's fold of its clients' GAUGE observations lives as a
+    fleet.<gauge> histogram — the uplink summary must carry it (and
+    the root must fold it), or the subtree's gauge families vanish."""
+    # the leaf folded two client compress.ratio observations
+    assert export.fold_fleet({"v": 1, "g": {"compress.ratio": 4.0}})
+    assert export.fold_fleet({"v": 1, "g": {"compress.ratio": 6.0}})
+    leaf_snap = export.fleet_snapshot(metrics_on)
+    s = export.fleet_summary(leaf_snap, {})
+    h = s["h"]["compress.ratio"]
+    assert h["n"] == 2 and h["mn"] == 4.0 and h["mx"] == 6.0
+    # a fresh "root" registry folds the forwarded summary
+    root = MetricsRegistry()
+    assert export.fold_fleet(s, registry=root)
+    rh = root.snapshot()["histograms"]["fleet.compress.ratio"]
+    assert rh["count"] == 2 and rh["min"] == 4.0 and rh["max"] == 6.0
+
+
+def test_slo_rate_normalizes_by_real_covered_span():
+    """With a tick interval COARSER than the window, the counter delta
+    spans the whole interval — dividing by the nominal window would
+    overestimate the rate by interval/window and false-breach."""
+    reg = MetricsRegistry()
+    clock = [0.0]
+    eng, rec = _engine("errs:rate<1.0@10s", reg, clock)
+    # 0.5 errs/s true rate, observed through 60s ticks: the naive
+    # delta/window computation would report 30/10 = 3.0 and breach
+    for _ in range(5):
+        reg.inc("errs", 30)
+        eng.tick()
+        clock[0] += 60.0
+    v = eng.verdicts()[0]
+    assert v["ok"], v
+    assert v["last_value"] is not None and v["last_value"] < 1.0, v
+
+
+def test_fleet_snapshot_reads_only_whitelisted_families(metrics_on):
+    metrics_on.inc("transport.bytes_by_type.c2s_result", 10)
+    metrics_on.inc("some.other.counter", 99)
+    metrics_on.observe("perf.round_wall_s", 0.5)
+    metrics_on.observe("round.wall_s", 0.5)
+    snap = export.fleet_snapshot(metrics_on)
+    assert set(snap["counters"]) == {
+        "transport.bytes_by_type.c2s_result"
+    }
+    assert set(snap["histograms"]) == {"perf.round_wall_s"}
+    # raw histogram shape, no interpolated percentiles on this path
+    assert "p99" not in snap["histograms"]["perf.round_wall_s"]
