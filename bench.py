@@ -532,7 +532,7 @@ def rate_record(sim, metric: str, rounds: int, torch_kind: str | None,
     flops = useful_round_cost(sim)
     bbytes = compulsory_round_bytes(sim)
     kind = jax.devices()[0].device_kind
-    peak_flops, peak_bw = PEAKS.get(kind, (None, None))
+    peak_flops, peak_bw = PEAKS.get(kind, (None,) * 3)[:2]
     delivered = flops * rps if flops else None
     mfu = delivered / peak_flops if delivered and peak_flops else None
     hbm = bbytes * rps / peak_bw if bbytes and peak_bw else None
@@ -687,7 +687,7 @@ def fused_rate_records(sim, metric: str, rounds: int,
     rps, rps_median, rates = fused_rate_bench(sim, rounds, fuse)
     flops = useful_round_cost(sim)
     kind = jax.devices()[0].device_kind
-    peak_flops, _ = PEAKS.get(kind, (None, None))
+    peak_flops = PEAKS.get(kind, (None,) * 3)[0]
     delivered = flops * rps if flops else None
     mfu = delivered / peak_flops if delivered and peak_flops else None
     rec = {
@@ -1120,7 +1120,7 @@ def fedgdkd_record(
         vs = rps * anchor_s
     flops = fedgdkd_useful_round_cost(sim)
     kind = jax.devices()[0].device_kind
-    peak_flops, _ = PEAKS.get(kind, (None, None))
+    peak_flops = PEAKS.get(kind, (None,) * 3)[0]
     delivered = flops * rps if flops else None
     # the GAN family trains in f32; the PEAKS table is the bf16 MXU
     # peak, so this mfu is a conservative LOWER bound on utilization
@@ -1686,6 +1686,112 @@ def elastic_churn_record(rounds=24, num_clients=32, cohort=16, seed=0):
     }
 
 
+def mem_bench_records(cohorts=(8, 64, 256), fuses=(1, 8)):
+    """Memory-scaling stage (``--mem-bench``; docs/PERFORMANCE.md
+    "Memory accounting"): peak HBM of ONE compiled round at cohort
+    sizes C and fusion depths K, as ``peak_round_hbm_mb_c{C}_k{K}``
+    records with a lower-is-better ``MB peak`` unit in bench_diff.
+
+    This pins today's O(C) growth of the stacked ``[C, ...]`` round as
+    the BASELINE the device-resident bulk-client engine (ROADMAP item
+    2, FedJAX's ``for_each_client`` idiom) must flatten to O(block) —
+    the acceptance instrumentation lands one PR ahead of the refactor.
+    On a real device backend the value is the allocator's
+    ``peak_bytes_in_use`` after executing the round; on the CPU
+    fallback (no allocator stats) it is the ANALYTIC
+    ``temp + argument`` bytes of the compiled program's
+    ``memory_analysis()``, marked ``"analytic": true`` — and the
+    record carries the PR 6 ``"fallback": "cpu"`` mark via emit(), so
+    bench_diff never compares it against TPU peaks. The cohort-grouped
+    fast path is disabled so the measured program is the vmapped
+    stacked round the bulk-client engine will replace. NOTE the device
+    peak is allocator-lifetime (not resettable), so device-backed
+    values are monotone across the sweep; the analytic columns ride
+    along per record either way."""
+    import jax
+
+    from fedml_tpu.config import (
+        DataConfig,
+        ExperimentConfig,
+        FedConfig,
+        ModelConfig,
+        TrainConfig,
+    )
+    from fedml_tpu.algorithms.fedavg import FedAvgSim
+    from fedml_tpu.core import memscope as M
+    from fedml_tpu.core import telemetry
+    from fedml_tpu.data.loaders import load_dataset
+    from fedml_tpu.models import create_model
+
+    was_enabled = telemetry.METRICS.enabled
+    telemetry.METRICS.enabled = True
+    records = []
+    kind = jax.devices()[0].device_kind
+    try:
+        for c in cohorts:
+            for k in fuses:
+                # the procedural LEAF synthetic generator: per-client
+                # sample draws make the DATASET scale with C too, so
+                # the argument-bytes column shows the O(C) law (a
+                # fixed-total dataset like fake_mnist would hide it)
+                cfg = ExperimentConfig(
+                    data=DataConfig(dataset="synthetic_1_1",
+                                    num_clients=c, batch_size=32,
+                                    seed=0),
+                    model=ModelConfig(name="lr", num_classes=10,
+                                      input_shape=(60,)),
+                    train=TrainConfig(lr=0.1, epochs=1,
+                                      cohort_fused=False),
+                    fed=FedConfig(num_rounds=k, clients_per_round=c,
+                                  eval_every=10**9, fuse_rounds=k),
+                    seed=0,
+                )
+                sim = FedAvgSim(create_model(cfg.model),
+                                load_dataset(cfg.data), cfg)
+                state = sim.init()
+                if k > 1:
+                    state, _ = sim.run_block(state, k)
+                    prog = M.program_record("sim_block",
+                                            (sim._bucket, k))
+                else:
+                    state, _ = sim.run_round(state)
+                    prog = M.program_record("sim_round", sim._bucket)
+                jax.block_until_ready(jax.tree.leaves(state))
+                sample = M.MONITOR.sample(tag=f"mem_bench_c{c}_k{k}")
+                assert prog is not None, "program accounting missing"
+                analytic_mb = (
+                    prog["temp_bytes"] + prog["argument_bytes"]
+                ) / 1e6
+                real_peak = (
+                    sample["peak_bytes"]
+                    if sample and sample["source"] == "device"
+                    else None
+                )
+                records.append({
+                    "metric": f"peak_round_hbm_mb_c{c}_k{k}",
+                    "value": round(
+                        (real_peak / 1e6) if real_peak
+                        else analytic_mb, 3,
+                    ),
+                    "unit": "MB peak",
+                    "vs_baseline": None,
+                    "analytic": real_peak is None,
+                    "cohort": c,
+                    "fuse_rounds": k,
+                    "temp_mb": round(prog["temp_bytes"] / 1e6, 3),
+                    "argument_mb": round(
+                        prog["argument_bytes"] / 1e6, 3
+                    ),
+                    "output_mb": round(prog["output_bytes"] / 1e6, 3),
+                    "compile_s": round(prog.get("compile_s", 0.0), 3),
+                    "device": kind,
+                })
+                del sim, state
+    finally:
+        telemetry.METRICS.enabled = was_enabled
+    return records
+
+
 # the probe replicates the platform selection bench itself uses (honor
 # JAX_PLATFORMS even though sitecustomize pins the platform via
 # jax.config — same escape hatch as experiments/run.py)
@@ -1858,6 +1964,17 @@ def main():
     ap.add_argument("--fuse-rounds", type=int, default=8,
                     help="block length K for the fused stages "
                          "(rounds per compiled program)")
+    ap.add_argument("--mem-bench", action="store_true",
+                    help="ONLY the memory-scaling stage: peak HBM of "
+                         "one compiled round at cohort sizes "
+                         "C in {8,64,256} x fusion K in {1,8} "
+                         "(peak_round_hbm_mb_c{C}_k{K}, lower-is-"
+                         "better 'MB peak' unit) — real "
+                         "peak_bytes_in_use on a device backend, "
+                         "analytic temp+argument bytes marked "
+                         "'analytic' on the CPU fallback; the O(C) "
+                         "baseline the bulk-client engine must "
+                         "flatten (docs/PERFORMANCE.md)")
     ap.add_argument("--fallback-only", action="store_true",
                     help="emit ONLY the marked CPU-fallback record "
                          "(+ one small labeled CPU measurement): the "
@@ -1985,6 +2102,10 @@ def main():
         return
     if args.elastic_bench:
         emit(staged("elastic", elastic_churn_record))
+        return
+    if args.mem_bench:
+        for rec in staged("mem", mem_bench_records):
+            emit(rec)
         return
     if args.async_bench:
         for rec in staged("async", async_bench_records):
@@ -2133,6 +2254,16 @@ def main():
             emit(rec)
     except Exception as err:
         print(f"[bench] async stage failed: {err}", file=sys.stderr,
+              flush=True)
+    try:
+        # memory scaling of the compiled round (peak HBM vs cohort x
+        # fusion): the O(C) baseline the bulk-client engine must
+        # flatten — tracked lower-is-better by bench_diff from this
+        # PR on (docs/PERFORMANCE.md "Memory accounting")
+        for rec in staged("mem", mem_bench_records):
+            emit(rec)
+    except Exception as err:
+        print(f"[bench] mem stage failed: {err}", file=sys.stderr,
               flush=True)
     sim, _ = build_sim(model_name="resnet56")
     emit(staged(

@@ -173,6 +173,16 @@ print(f"perf smoke ok: {len(perf['rounds'])} profiled rounds, "
       f"{len(rows)} time-series rows")
 EOF
 
+echo "== mem smoke: per-program HBM accounting + donation audit + /statusz memory =="
+# the memory-observability plane end-to-end on CPU: mem.program.*
+# argument bytes grow with cohort size, mem.compile_s histograms have
+# entries, the donation audit passes on the real fused round and flags
+# an undonated control, the monitor runs on the marked RSS fallback,
+# /metrics + /statusz serve the mem vocabulary, and the
+# peak_round_hbm_mb_c{8,64,256}_k{1,8} bench records diff
+# lower-is-better (docs/OBSERVABILITY.md "Memory & compilation")
+JAX_PLATFORMS=cpu python scripts/mem_smoke.py "$OUT/mem"
+
 echo "== fuse smoke: --fuse_rounds 4 parity + one compile per (bucket, K) =="
 # a tiny sim fused at K=4 must reproduce the unfused run's final loss,
 # compile exactly one block program per (bucket, block length), log a

@@ -28,6 +28,7 @@ from fedml_tpu.core import adversary as A
 from fedml_tpu.core import compress as CMP
 from fedml_tpu.core import elastic as E
 from fedml_tpu.core import export as EXPORT
+from fedml_tpu.core import memscope as MEMSCOPE
 from fedml_tpu.core import robust, telemetry
 from fedml_tpu.core import tree as T
 from fedml_tpu.core.membership import MembershipLedger
@@ -301,11 +302,13 @@ class FedAvgServerActor(ServerManager):
         # zero-copy SIGSEGV fix documents). The sim round donates
         # instead, where the state has exactly one owner.
         self._agg_cache = (
-            E.CompiledRoundCache(self._bucketed_update)
+            E.CompiledRoundCache(self._bucketed_update,
+                                 family="deploy_update")
             if self._elastic else None
         )
         self._diag_cache = (
-            E.CompiledRoundCache(self._bucketed_diag)
+            E.CompiledRoundCache(self._bucketed_diag,
+                                 family="deploy_diag")
             if self._elastic else None
         )
         # -- compressed weight-update wire (core/compress.py,
@@ -328,8 +331,14 @@ class FedAvgServerActor(ServerManager):
                 CMP.wire_ratio(self._cspec, self.state.variables),
             )
         self._decomp_cache = (
-            E.CompiledRoundCache(self._decompress_prog)
+            E.CompiledRoundCache(self._decompress_prog,
+                                 family="deploy_decompress")
             if self._cspec.enabled() else None
+        )
+        # memory-plane knobs (core/memscope.py): the monitor samples at
+        # every round close below; --mem_headroom_warn tunes its alarm
+        MEMSCOPE.MONITOR.headroom_warn = float(
+            getattr(cfg.fed, "mem_headroom_warn", 0.9) or 0.9
         )
         # -- mesh-sharded server update (parallel/sharded_agg.py,
         # ROADMAP item 2): shard decompress -> clip -> defense-reduce
@@ -1354,6 +1363,10 @@ class FedAvgServerActor(ServerManager):
                              "the server device is idle waiting on "
                              "clients/transport",
                     )
+            # round-close device-memory sample (core/memscope.py):
+            # live/peak bytes + headroom gauges at the same boundary
+            # the wall-time accounting uses
+            MEMSCOPE.MONITOR.sample(tag=f"round{closed_idx}")
         if self._ckpt is not None and (
             (closed_idx + 1) % self.checkpoint_every == 0
             or closed_idx + 1 >= self.cfg.fed.num_rounds

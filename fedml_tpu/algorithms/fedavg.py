@@ -33,6 +33,7 @@ from fedml_tpu.config import ExperimentConfig, FedConfig, TrainConfig
 from fedml_tpu.core import adversary as A
 from fedml_tpu.core import compress as C
 from fedml_tpu.core import elastic as E
+from fedml_tpu.core import memscope as M
 from fedml_tpu.core import random as R
 from fedml_tpu.core import robust, telemetry, tree as T
 from fedml_tpu.data.federated import FederatedArrays, FederatedData, arrays_and_batch
@@ -67,6 +68,11 @@ def consume_round_counters(train_metrics: dict) -> dict:
         # the error-feedback carry (docs/OBSERVABILITY.md): bounded ==
         # compression error is telescoping carry, not accumulating bias
         telemetry.METRICS.gauge("compress.residual_norm", float(res))
+    # round-boundary device-memory sample (core/memscope.py): every
+    # sim round loop funnels through here exactly once per round with
+    # the metrics already forced to host — the natural boundary for
+    # the live mem.* gauges. One attribute check when telemetry is off.
+    M.MONITOR.sample()
     return train_metrics
 
 
@@ -340,7 +346,16 @@ class FedAvgSim:
         self._cspec = C.CompressionSpec.from_fed(cfg.fed, seed=cfg.seed)
         self._ef_residual = None  # lazy zero carry, [bucket, ...]
         donate = (0, 3) if self._cspec.enabled() else (0,)
-        self._round_fn = jax.jit(self._round, donate_argnums=donate)
+        # the round program is an instrumented AOT site
+        # (core/memscope.py): compiles are explicit .lower().compile()
+        # calls — byte-identical lowering to a first jit call — so
+        # every compile is timed (mem.compile_s.sim_round), its
+        # memory_analysis recorded (mem.program.*), and the donated
+        # state/residual audited is_deleted after the first execution.
+        # ProgramSite exposes _cache_size, so the elastic paths'
+        # mirror_jit_cache accounting is unchanged.
+        self._round_fn = M.ProgramSite(self._round, family="sim_round",
+                                       donate_argnums=donate)
         # -- fused multi-round execution (core/fuse.py, docs/
         # PERFORMANCE.md "Round fusion"): with fuse_rounds K > 1 ONE
         # compiled program runs K complete rounds as a lax.scan over
@@ -361,9 +376,15 @@ class FedAvgSim:
         # the SAME fused-block scan wraps either body
         self._round_impl = self._round
         self._block_fn = (
-            jax.jit(self._fused_block, static_argnums=(4,),
-                    donate_argnums=donate)
+            M.ProgramSite(self._fused_block, family="sim_block",
+                          static_argnums=(4,), donate_argnums=donate)
             if self._fuse > 1 else None
+        )
+        # process-global headroom threshold for the memory monitor
+        # (--mem_headroom_warn; docs/OBSERVABILITY.md "Memory &
+        # compilation")
+        M.MONITOR.headroom_warn = float(
+            getattr(cfg.fed, "mem_headroom_warn", 0.9) or 0.9
         )
 
     def _prepare_data(self, data: FederatedData, cfg: ExperimentConfig):
@@ -672,10 +693,11 @@ class FedAvgSim:
             jnp.asarray(self._n_active, jnp.int32)
             if self._elastic else None
         )
+        key = (self._bucket, length)
 
         def call():
             return self._block_fn(
-                state, operand, n,
+                key, state, operand, n,
                 self._ef_residual if compressed else None, length,
             )
 
@@ -699,26 +721,27 @@ class FedAvgSim:
                 "compress.ratio",
                 C.wire_ratio(self._cspec, state.variables),
             )
+        key = self._bucket
         if not self._elastic:
             if not compressed:
-                return self._round_fn(state, self.arrays)
+                return self._round_fn(key, state, self.arrays)
             state, m, self._ef_residual = self._round_fn(
-                state, self.arrays, None, self._ef_residual
+                key, state, self.arrays, None, self._ef_residual
             )
             return state, m
         # the live count rides as a TRACED operand: any cohort size in
-        # [1, bucket] reuses the one compiled program; jit's own cache
+        # [1, bucket] reuses the one compiled program; the ProgramSite
         # is the executable store here
         n = jnp.asarray(self._n_active, jnp.int32)
         if not compressed:
             return E.mirror_jit_cache(
                 self._round_fn,
-                lambda: self._round_fn(state, self.arrays, n),
+                lambda: self._round_fn(key, state, self.arrays, n),
             )
         state, m, self._ef_residual = E.mirror_jit_cache(
             self._round_fn,
             lambda: self._round_fn(
-                state, self.arrays, n, self._ef_residual
+                key, state, self.arrays, n, self._ef_residual
             ),
         )
         return state, m

@@ -166,6 +166,13 @@ class FedConfig:
     # (round rate, MFU, dispatch-bound detector) for the whole run.
     # 0 = off (no capture, no gauges, no extra cost-analysis compile).
     profile_rounds: int = 0
+    # memory observability (core/memscope.py, docs/OBSERVABILITY.md
+    # "Memory & compilation"): the device-memory monitor leaves ONE
+    # flight-recorder event the first time any device's used fraction
+    # of HBM capacity crosses this threshold. Sampling itself rides
+    # the telemetry plane (on when metrics are on, one attribute
+    # check otherwise).
+    mem_headroom_warn: float = 0.9
     # fused multi-round execution (core/fuse.py, docs/PERFORMANCE.md
     # "Round fusion"): run K complete rounds as ONE compiled program —
     # a lax.scan over the round body with the server state (and the

@@ -53,6 +53,7 @@ acceptance tests pin.
 from __future__ import annotations
 
 import threading
+import time
 from collections import OrderedDict
 from typing import Callable
 
@@ -60,7 +61,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from fedml_tpu.core import telemetry
+from fedml_tpu.core import memscope, telemetry
 
 
 def bucket_for(n: int, min_bucket: int = 1) -> int:
@@ -173,16 +174,25 @@ class CompiledRoundCache:
     transport dispatch threads."""
 
     def __init__(self, fn: Callable, max_entries: int = 8,
-                 static_argnums=(), jit_kwargs: dict | None = None):
+                 static_argnums=(), jit_kwargs: dict | None = None,
+                 family: str | None = None):
         """``jit_kwargs`` passes straight through to ``jax.jit`` —
         the sharded-aggregation path uses it for
         ``in_shardings``/``out_shardings`` (client-axis NamedSharding);
         ``donate_argnums`` is accepted for callers whose operands have
         a single owner (the actor paths deliberately do not donate —
-        see parallel/sharded_agg.py)."""
+        see parallel/sharded_agg.py). ``family`` names this site in the
+        memory-observability plane (core/memscope.py): every miss's
+        compile wall lands in the ``mem.compile_s.<family>`` histogram
+        and its ``memory_analysis()`` in the ``mem.program.*`` gauges —
+        default is the wrapped function's name."""
         self._fn = fn
         self._static_argnums = tuple(static_argnums)
         self._jit_kwargs = dict(jit_kwargs or {})
+        self.family = (
+            family
+            or getattr(fn, "__name__", "program").lstrip("_")
+        )
         self.max_entries = max_entries
         self._cache: OrderedDict[object, object] = OrderedDict()
         self._lock = threading.Lock()
@@ -199,12 +209,14 @@ class CompiledRoundCache:
             if exe is not None:
                 self._cache.move_to_end(bucket)
         if exe is None:
+            t0 = time.perf_counter()
             exe = (
                 jax.jit(self._fn, static_argnums=self._static_argnums,
                         **self._jit_kwargs)
                 .lower(*args)
                 .compile()
             )
+            compile_s = time.perf_counter() - t0
             evicted = False
             with self._lock:
                 self._cache[bucket] = exe
@@ -219,6 +231,11 @@ class CompiledRoundCache:
             if evicted:
                 telemetry.METRICS.inc("elastic.compile_cache_evictions")
             telemetry.RECORDER.record("elastic_compile", bucket=bucket)
+            # a miss is no longer a bare counter bump: the compile wall
+            # (eviction thrash burns seconds, not just counts) and the
+            # executable's memory analysis are recorded per program
+            memscope.note_program(self.family, bucket, exe,
+                                  compile_s=compile_s)
         else:
             with self._lock:
                 self.stats["hits"] += 1

@@ -121,15 +121,27 @@ class SysStats:
             if net else 0,
         }
         try:
-            import jax
+            # ONE memory path (core/memscope.py; docs/OBSERVABILITY.md
+            # "Memory & compilation"): the same reader the
+            # DeviceMemoryMonitor samples — every device (not just the
+            # first), documented mem.* names, and the RSS fallback on
+            # backends without memory_stats (marked by mem.source)
+            from fedml_tpu.core import memscope
 
-            dev = jax.devices()[0]
-            stats = getattr(dev, "memory_stats", lambda: None)()
-            if stats:
-                out["device_memory_in_use"] = stats.get("bytes_in_use", 0)
-                out["device_memory_limit"] = stats.get(
-                    "bytes_limit", stats.get("bytes_reservable_limit", 0)
+            source, readings = memscope.read_device_memory()
+            if readings:
+                out["mem.source"] = source
+                out["mem.bytes_in_use"] = sum(
+                    r["bytes_in_use"] for r in readings
                 )
+                peaks = [r["peak_bytes"] for r in readings
+                         if r["peak_bytes"]]
+                if peaks:
+                    out["mem.peak_bytes"] = max(peaks)
+                caps = [r["capacity_bytes"] for r in readings
+                        if r["capacity_bytes"]]
+                if caps:
+                    out["mem.capacity_bytes"] = sum(caps)
         except Exception:  # noqa: BLE001 — telemetry must never crash a run
             pass
         return out

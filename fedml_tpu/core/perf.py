@@ -57,20 +57,30 @@ from fedml_tpu.core import telemetry
 # chip peaks + the analytic round-cost model (shared with bench.py)
 # ---------------------------------------------------------------------------
 
-# v5e (TPU v5 lite): 197 bf16 TFLOP/s, ~819 GB/s HBM. Fallbacks for other
-# chips; the point of MFU here is a stable, honest denominator.
-PEAKS: dict[str, tuple[float, float]] = {
-    "TPU v5 lite": (197e12, 819e9),
-    "TPU v4": (275e12, 1228e9),
-    "TPU v5p": (459e12, 2765e9),
-    "TPU v6 lite": (918e12, 1640e9),
+# (bf16 peak FLOP/s, HBM bandwidth B/s, HBM capacity bytes) per chip.
+# v5e (TPU v5 lite): 197 bf16 TFLOP/s, ~819 GB/s, 16 GB HBM. Fallbacks
+# for other chips; the point of MFU here is a stable, honest
+# denominator, and the capacity column is the headroom denominator the
+# memory monitor (core/memscope.py) alarms against.
+PEAKS: dict[str, tuple[float, float, float]] = {
+    "TPU v5 lite": (197e12, 819e9, 16e9),
+    "TPU v4": (275e12, 1228e9, 32e9),
+    "TPU v5p": (459e12, 2765e9, 95e9),
+    "TPU v6 lite": (918e12, 1640e9, 32e9),
 }
 
 
 def device_peak_flops(kind: str) -> float | None:
     """bf16 MXU peak for a device kind (None for unknown kinds — CPU
     hosts get no MFU gauge rather than a made-up denominator)."""
-    return PEAKS.get(kind, (None, None))[0]
+    return PEAKS.get(kind, (None, None, None))[0]
+
+
+def device_hbm_capacity(kind: str) -> float | None:
+    """Per-chip HBM capacity in bytes (None for unknown kinds — the
+    memory monitor then prefers the device's own ``bytes_limit`` and
+    otherwise reports no headroom rather than a made-up one)."""
+    return PEAKS.get(kind, (None, None, None))[2]
 
 
 _COST_CACHE: dict = {}

@@ -751,6 +751,17 @@ def shutdown() -> None:
         _export.reset_status_sources()
     except Exception:
         pass
+    try:
+        import sys as _sys
+
+        # memory-plane state (program table, headroom flag, high-water
+        # mark) resets with the rest of the plane — but only if the
+        # module was ever imported; shutdown must not pull it in
+        _mem = _sys.modules.get("fedml_tpu.core.memscope")
+        if _mem is not None:
+            _mem.reset()
+    except Exception:
+        pass
     METRICS.enabled = False
     METRICS.reset()
     RECORDER.enabled = False

@@ -265,6 +265,18 @@ def parse_args(argv=None) -> tuple[ExperimentConfig, argparse.Namespace]:
                         "telemetry dir (round-latency SLO time "
                         "series: histograms carry p50/p95/p99); "
                         "implies telemetry")
+    # -- memory observability (core/memscope.py; docs/OBSERVABILITY.md
+    # "Memory & compilation") ----------------------------------------------
+    p.add_argument("--mem_headroom_warn", type=float, default=None,
+                   help="used fraction of device HBM capacity at which "
+                        "the memory monitor leaves its one "
+                        "mem_headroom flight-recorder event (default "
+                        "0.9). The monitor itself rides the telemetry "
+                        "plane: per-device mem.bytes_in_use/"
+                        "mem.peak_bytes gauges at round boundaries, "
+                        "per-program mem.program.* accounting at every "
+                        "compile, RSS fallback on backends without "
+                        "memory_stats")
     # -- live observability plane (core/export.py, core/slo.py;
     # docs/OBSERVABILITY.md "Live export and SLOs") -------------------------
     p.add_argument("--metrics_port", type=int, default=None,
@@ -439,6 +451,7 @@ def parse_args(argv=None) -> tuple[ExperimentConfig, argparse.Namespace]:
             compress_topk_frac=a.compress_topk_frac,
             shard_aggregation=True if a.shard_aggregation else None,
             profile_rounds=a.profile_rounds,
+            mem_headroom_warn=a.mem_headroom_warn,
             fuse_rounds=a.fuse_rounds,
             slos=tuple(a.slo) if a.slo else None,
         ),
@@ -489,6 +502,11 @@ def parse_args(argv=None) -> tuple[ExperimentConfig, argparse.Namespace]:
             raise ValueError(
                 f"--metrics_port must be in [0, 65535] (0 = "
                 f"ephemeral), got {a.metrics_port}"
+            )
+        if not (0.0 < cfg.fed.mem_headroom_warn <= 1.0):
+            raise ValueError(
+                f"--mem_headroom_warn is a used FRACTION of device "
+                f"memory in (0, 1], got {cfg.fed.mem_headroom_warn}"
             )
         if a.tier_spec is not None:
             TierSpec.parse(a.tier_spec)

@@ -40,6 +40,7 @@ from fedml_tpu.core.compat import shard_map
 
 from fedml_tpu.config import ExperimentConfig
 from fedml_tpu.core import elastic as E
+from fedml_tpu.core import memscope as M
 from fedml_tpu.core import random as R
 from fedml_tpu.data.federated import FederatedData, shard_client_banks
 from fedml_tpu.algorithms.base import (
@@ -161,7 +162,13 @@ class ShardedFedAvg(FedAvgSim):
             and not self._elastic
             else None
         )
-        self._round_fn = jax.jit(self._sharded_round, donate_argnums=(0,))
+        # instrumented AOT site like the single-device round
+        # (core/memscope.py): compile wall + memory_analysis recorded
+        # per program, the donated state audited on first execution
+        self._round_fn = M.ProgramSite(
+            self._sharded_round, family="sharded_round",
+            donate_argnums=(0,),
+        )
         # round fusion (docs/PERFORMANCE.md "Round fusion"): the
         # inherited _fused_block scans over whatever _round_impl names
         # — rebinding it here makes the fused block run the shard_map'd
@@ -295,12 +302,13 @@ class ShardedFedAvg(FedAvgSim):
         return self.banks
 
     def run_round(self, state):
+        key = self.bucket_per_shard
         if not self._elastic:
-            return self._round_fn(state, self.banks)
+            return self._round_fn(key, state, self.banks)
         return E.mirror_jit_cache(
             self._round_fn,
             lambda: self._round_fn(
-                state, self.banks,
+                key, state, self.banks,
                 jnp.asarray(self._n_active, jnp.int32),
             ),
         )

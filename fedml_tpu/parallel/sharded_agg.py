@@ -125,6 +125,7 @@ class ShardedAggregator:
                               self._rows, self._rep),
                 out_shardings=self._rep,
             ),
+            family="sharded_agg_update",
         )
         self._decomp_cache = (
             E.CompiledRoundCache(
@@ -134,6 +135,7 @@ class ShardedAggregator:
                     in_shardings=(self._rows, self._rep),
                     out_shardings=self._rows,
                 ),
+                family="sharded_agg_decompress",
             )
             if self._spec is not None else None
         )

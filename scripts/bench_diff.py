@@ -47,7 +47,10 @@ _HIGHER = ("rounds/sec", "hit_rate", "% test acc", "accuracy", "acc",
            # fedavg_mfu_*_fused records — the MFU-recovery acceptance
            # surface is a tracked value, not a side-field
            "mfu")
-_LOWER = ("seconds", "ms/round", "s", "ms", "MB/round")
+#: "MB peak": the --mem-bench peak-HBM records (peak_round_hbm_mb_*) —
+#: memory growth is a regression; the fallback-mark rule above already
+#: keeps analytic CPU records from ever diffing against device peaks.
+_LOWER = ("seconds", "ms/round", "s", "ms", "MB/round", "MB peak")
 
 
 def extract_records(text: str) -> dict[str, dict]:

@@ -1,0 +1,229 @@
+"""Memory-observability smoke (ci.sh; docs/OBSERVABILITY.md "Memory &
+compilation").
+
+A CPU-only end-to-end pass over the whole memory plane
+(fedml_tpu/core/memscope.py):
+
+1. two compiled sims at different cohort sizes leave ``mem.program.*``
+   accounting whose ARGUMENT bytes grow with C (the O(C) stacked-round
+   law the bulk-client engine must flatten) and ``mem.compile_s``
+   histogram entries per program family;
+2. the donation audit passes on the real fused round (ServerState and
+   the EF residual are donated scan carries — 0 misses) AND flags an
+   intentionally-undonated control program (>= 1 miss + one flight
+   event naming it);
+3. the live monitor samples on the RSS fallback (CPU devices report no
+   ``memory_stats``) with the source marked, and the headroom flight
+   event fires exactly once when the threshold is crossed;
+4. ``/metrics`` exposes the ``mem.*`` vocabulary over real HTTP and
+   ``/statusz`` serves the ``memory`` section (per-device readings,
+   program table, donation counts);
+5. the bench stage shape: ``peak_round_hbm_mb_c{8,64,256}_k{1,8}``
+   records land in a bench-artifact-style JSONL, carry the CPU
+   fallback mark, diff lower-is-better under scripts/bench_diff.py,
+   and a fallback-vs-clean pair is REFUSED for the new unit too.
+
+Usage: python scripts/mem_smoke.py <workdir>
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import urllib.request
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def main() -> int:
+    workdir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/mem_smoke"
+    os.makedirs(workdir, exist_ok=True)
+
+    import jax
+
+    from fedml_tpu.algorithms.fedavg import FedAvgSim
+    from fedml_tpu.config import (
+        DataConfig, ExperimentConfig, FedConfig, ModelConfig,
+        TrainConfig,
+    )
+    from fedml_tpu.core import memscope as M
+    from fedml_tpu.core import telemetry
+    from fedml_tpu.data.loaders import load_dataset
+    from fedml_tpu.models import create_model
+
+    tdir = os.path.join(workdir, "telemetry")
+    telemetry.configure(telemetry_dir=tdir, rank=0, metrics_port=0)
+
+    def cfg(c, k=1):
+        # synthetic_1_1: per-client sample draws — the dataset (and so
+        # the round program's ARGUMENT bytes) scales with C, which is
+        # exactly the growth law assertion 1 pins
+        return ExperimentConfig(
+            data=DataConfig(dataset="synthetic_1_1", num_clients=c,
+                            batch_size=16, seed=0),
+            model=ModelConfig(name="lr", num_classes=10,
+                              input_shape=(60,)),
+            train=TrainConfig(lr=0.1, epochs=1, cohort_fused=False),
+            fed=FedConfig(num_rounds=max(2, k), clients_per_round=c,
+                          eval_every=10**9, fuse_rounds=k),
+            seed=0,
+        )
+
+    def build(c, k=1):
+        conf = cfg(c, k)
+        return FedAvgSim(create_model(conf.model),
+                         load_dataset(conf.data), conf)
+
+    # -- 1. per-program accounting grows with C --------------------------
+    small_c, big_c = 4, 8
+    for c in (small_c, big_c):
+        sim = build(c)
+        state = sim.init()
+        for _ in range(2):
+            state, _ = sim.run_round(state)
+        jax.block_until_ready(jax.tree.leaves(state))
+        del sim, state
+    small = M.program_record("sim_round", small_c)
+    big = M.program_record("sim_round", big_c)
+    assert small and big, (
+        f"mem.program accounting missing: {sorted(M.program_table())}"
+    )
+    assert big["argument_bytes"] > small["argument_bytes"], (
+        f"argument bytes must grow with C: "
+        f"C={small_c} -> {small['argument_bytes']}, "
+        f"C={big_c} -> {big['argument_bytes']}"
+    )
+    snap = telemetry.METRICS.snapshot()
+    compile_hists = {
+        k: v for k, v in snap["histograms"].items()
+        if k.startswith("mem.compile_s.")
+    }
+    assert compile_hists and all(
+        v["count"] >= 1 and v["sum"] > 0 for v in compile_hists.values()
+    ), f"mem.compile_s entries missing: {sorted(snap['histograms'])}"
+    gauges = snap["gauges"]
+    prog_gauges = [g for g in gauges if g.startswith("mem.program.")]
+    assert prog_gauges, "mem.program.* gauges missing"
+
+    # -- 2. donation audit: real fused round passes, control flagged -----
+    fsim = build(small_c, k=2)
+    fstate = fsim.init()
+    fstate, _ = fsim.run_block(fstate, 2)
+    jax.block_until_ready(jax.tree.leaves(fstate))
+    c0 = telemetry.METRICS.snapshot()["counters"]
+    assert c0.get("mem.donation_audits", 0) >= 1, c0
+    assert c0.get("mem.donation_misses", 0) == 0, (
+        f"the fused round's donated carries must be consumed: {c0}"
+    )
+    # control: a program that does NOT donate its input — the audit
+    # must flag the live buffer as a donation miss
+    import jax.numpy as jnp
+
+    control_in = jnp.ones((32, 32))
+    undonated = jax.jit(lambda x: x * 2.0)
+    jax.block_until_ready(undonated(control_in))
+    ok = M.audit_donation("control_undonated", 0,
+                          jax.tree.leaves(control_in))
+    assert not ok, "the undonated control must fail the audit"
+    c1 = telemetry.METRICS.snapshot()["counters"]
+    assert c1.get("mem.donation_misses", 0) >= 1, c1
+    events = [e for e in telemetry.RECORDER._ring
+              if e.get("kind") == "mem_donation_miss"]
+    assert events and "control_undonated" in events[-1]["program"], (
+        "the donation-miss flight event must name the program"
+    )
+
+    # -- 3. monitor: RSS fallback marked, headroom event fires once ------
+    sample = M.MONITOR.sample()
+    assert sample is not None and sample["bytes_in_use"] > 0, sample
+    assert sample["source"] in ("device", "rss"), sample
+    if sample["source"] == "rss":
+        assert telemetry.METRICS.snapshot()["gauges"].get(
+            "mem.source_rss") == 1.0
+    M.MONITOR.headroom_warn = 1e-9  # force a crossing
+    M.MONITOR.sample()
+    M.MONITOR.sample()
+    headroom = [e for e in telemetry.RECORDER._ring
+                if e.get("kind") == "mem_headroom"]
+    assert len(headroom) == 1, (
+        f"headroom flight event must fire exactly once, got "
+        f"{len(headroom)}"
+    )
+
+    # -- 4. live /metrics + /statusz memory section ----------------------
+    port = telemetry.exporter().port
+    text = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=5
+    ).read().decode()
+    assert "mem_bytes_in_use" in text and "mem_program_" in text, (
+        "mem.* must ride /metrics"
+    )
+    assert "mem_compile_s_" in text and "_bucket{le=" in text, text[:500]
+    statusz = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/statusz", timeout=5
+    ).read().decode())
+    memsec = statusz.get("memory")
+    assert memsec, f"/statusz memory section missing: {sorted(statusz)}"
+    assert memsec["source"] in ("device", "rss")
+    assert memsec["devices"] and memsec["programs"], memsec
+    assert memsec["donation_misses"] >= 1, memsec
+
+    # -- 5. bench stage: records land + lower-is-better + mixed refusal --
+    import bench
+    from scripts import bench_diff
+
+    direction, known = bench_diff._direction("MB peak")
+    assert (direction, known) == (-1, True), (
+        "'MB peak' must diff lower-is-better"
+    )
+    records = bench.mem_bench_records()
+    names = {r["metric"] for r in records}
+    want = {f"peak_round_hbm_mb_c{c}_k{k}"
+            for c in (8, 64, 256) for k in (1, 8)}
+    assert names == want, f"missing records: {want - names}"
+    artifact = os.path.join(workdir, "mem_bench.jsonl")
+    with open(artifact, "w") as f:
+        for r in records:
+            # emit()'s fallback rule, applied the same way: CPU-backend
+            # measurements are always marked
+            if jax.default_backend() == "cpu":
+                r = dict(r, fallback="cpu")
+            assert r["unit"] == "MB peak" and r["value"] > 0, r
+            f.write(json.dumps(r) + "\n")
+    loaded = bench_diff.load_bench(artifact)
+    assert set(loaded) == want
+    # growth law visible in the artifact: C=256 round holds more than
+    # the C=8 round at the same K (argument bytes scale with the stack)
+    assert (loaded["peak_round_hbm_mb_c256_k1"]["value"]
+            > loaded["peak_round_hbm_mb_c8_k1"]["value"])
+    # bench_diff refuses a fallback-vs-clean pair for the new unit too
+    clean = {k: dict(v) for k, v in loaded.items()}
+    for v in clean.values():
+        v.pop("fallback", None)
+    d = bench_diff.diff_records(loaded, clean, threshold=0.08)
+    assert len(d["skipped"]) == len(want) and not d["regressions"], d
+    # an honest same-side pair diffs normally (and a doubled peak
+    # regresses)
+    worse = {k: dict(v, value=v["value"] * 2) for k, v in loaded.items()}
+    d2 = bench_diff.diff_records(loaded, worse, threshold=0.08)
+    assert len(d2["regressions"]) == len(want), d2
+
+    telemetry.shutdown()
+    print(
+        f"mem smoke ok: {len(prog_gauges)} program gauges, "
+        f"{len(compile_hists)} compile-time families, "
+        f"argument bytes {small['argument_bytes']} -> "
+        f"{big['argument_bytes']} (C {small_c}->{big_c}), "
+        f"donation audits {c1.get('mem.donation_audits', 0)} "
+        f"(misses {int(c1.get('mem.donation_misses', 0))}, control "
+        f"flagged), source={sample['source']}, "
+        f"{len(records)} peak_round_hbm records"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
