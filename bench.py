@@ -1872,25 +1872,15 @@ def _run_cpu_fallback(args, emit, staged, probe_error: str) -> int:
     return 0
 
 
-#: flag names owned by the run CLI's live-observability plane
-#: (fedml_tpu/experiments/run.py: the SLO engine and the OpenMetrics
-#: exporter). A future bench stage minting its own ``--slo`` would
-#: shadow the runtime semantics with bench-local ones — the operator's
-#: muscle memory ('--slo means an SloSpec') must hold across every
-#: entrypoint, so registering a collision fails loudly at startup.
-RESERVED_RUN_FLAGS = ("--slo", "--metrics_port")
-
-
-def _assert_no_reserved_flags(ap) -> None:
-    taken = {s for act in ap._actions for s in act.option_strings}
-    clash = taken.intersection(RESERVED_RUN_FLAGS)
-    if clash:
-        raise SystemExit(
-            f"bench.py registered reserved flag(s) {sorted(clash)}: "
-            f"these names belong to the run CLI's SLO/export plane "
-            f"(fedml_tpu/experiments/run.py) — rename the bench stage "
-            f"flag"
-        )
+# Reserved-flag collision guard: ONE registration checker shared with
+# run.py and the deploy supervisor (fedml_tpu/analysis/flags.py) —
+# '--slo means an SloSpec' must hold across every entrypoint, so a
+# bench stage minting its own fails loudly at parser build.
+# RESERVED_RUN_FLAGS is re-exported for callers that pinned it here.
+from fedml_tpu.analysis.flags import (  # noqa: E402
+    RESERVED_RUN_FLAGS,
+    check_flag_registry,
+)
 
 
 def main():
@@ -1983,7 +1973,7 @@ def main():
                          "BENCH artifact instead of nothing "
                          "(docs/PERFORMANCE.md 'Bench "
                          "trustworthiness')")
-    _assert_no_reserved_flags(ap)
+    check_flag_registry(ap, entrypoint="bench.py")
     args = ap.parse_args()
 
     # Fail FAST if the device backend cannot come up: a wedged TPU

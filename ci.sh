@@ -20,6 +20,15 @@ CI_T0=$(date +%s)
 export JAX_COMPILATION_CACHE_DIR=${JAX_COMPILATION_CACHE_DIR:-/tmp/fedml_tpu_test_xla_cache}
 OUT=$(mktemp -d)
 
+echo "== fedlint: project-invariant static analysis (ratcheted) =="
+# AST-level invariant checks BEFORE the test tier — jit purity,
+# donation discipline, lock hygiene, metric/config/message-edge
+# contracts (docs/STATIC_ANALYSIS.md). Fails on any finding not frozen
+# in fedlint_baseline.json; the JSON artifact lands next to the
+# telemetry artifacts for the round notes.
+python scripts/fedlint.py fedml_tpu bench.py scripts \
+  --baseline fedlint_baseline.json --json "$OUT/fedlint.json"
+
 echo "== 1/3 fast test tier =="
 python -m pytest tests -m "not slow" -q -x -p no:cacheprovider
 

@@ -44,7 +44,7 @@ import optax
 from fedml_tpu.config import ExperimentConfig
 from fedml_tpu.core import random as R
 from fedml_tpu.core.manager import ClientManager, Manager, ServerManager
-from fedml_tpu.core.message import Message
+from fedml_tpu.core.message import MSG_TYPE_NAMES, Message
 from fedml_tpu.core.transport.base import BaseTransport
 from fedml_tpu.data.federated import FederatedData, arrays_and_batch
 from fedml_tpu.algorithms.base import make_client_optimizer
@@ -65,6 +65,24 @@ MSG_GKT_FEATURES = 111
 MSG_VFL_STEP = 120
 MSG_VFL_COMPONENT = 121
 MSG_VFL_GRAD = 122
+
+# Register symbolic names so the per-type wire-byte counters
+# (`transport.bytes_by_type.<name>`, docs/OBSERVABILITY.md) attribute
+# split-compute traffic readably — without these rows the counters fall
+# back to bare integers (`transport.bytes_by_type.101`), which is
+# exactly what the fedlint message-edge rule flags: a wire-cost claim
+# about activations vs gradients must be able to name them.
+MSG_TYPE_NAMES.update({
+    MSG_SNN_TURN: "snn_turn",
+    MSG_SNN_ACTS: "snn_acts",
+    MSG_SNN_GRADS: "snn_grads",
+    MSG_SNN_EPOCH_DONE: "snn_epoch_done",
+    MSG_GKT_START: "gkt_start",
+    MSG_GKT_FEATURES: "gkt_features",
+    MSG_VFL_STEP: "vfl_step",
+    MSG_VFL_COMPONENT: "vfl_component",
+    MSG_VFL_GRAD: "vfl_grad",
+})
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,15 @@
+"""fedlint rules. Importing this package registers every rule with
+:data:`fedml_tpu.analysis.core.RULES` (docs/STATIC_ANALYSIS.md has the
+catalog: each rule names the historical bug class it would have
+caught)."""
+
+from fedml_tpu.analysis.rules import (  # noqa: F401
+    config_contract,
+    donation,
+    jit_purity,
+    lock_hygiene,
+    message_edge,
+    metric_vocab,
+    recompile_hazard,
+    traced_branch,
+)

@@ -1,0 +1,80 @@
+"""Shared AST helpers for fedlint rules."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from fedml_tpu.analysis.core import FunctionInfo, ModuleInfo
+
+
+def own_walk(root: ast.AST) -> Iterator[ast.AST]:
+    """Walk ``root`` WITHOUT descending into nested function/class
+    bodies — each nested def is its own FunctionInfo and reports its
+    own findings; double-reporting through the parent would make one
+    defect two baseline entries."""
+    stack = [root]
+    first = True
+    while stack:
+        node = stack.pop()
+        if not first and isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                   ast.ClassDef)
+        ):
+            continue
+        first = False
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def dotted_base(expr: ast.AST) -> str | None:
+    """``np.random.normal`` -> "np.random"; ``time.time`` -> "time";
+    bare names -> None."""
+    parts: list[str] = []
+    node = expr
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        parts.reverse()
+        return ".".join(parts[:-1]) if len(parts) > 1 else None
+    return None
+
+
+def resolve_module(mod: ModuleInfo, dotted: str | None) -> str | None:
+    """Map a call's dotted base through the module's import aliases:
+    with ``import numpy as np``, "np.random" -> "numpy.random"."""
+    if not dotted:
+        return None
+    head, _, rest = dotted.partition(".")
+    full = mod.import_aliases.get(head)
+    if full is None:
+        frm = mod.from_imports.get(head)
+        if frm is None:
+            return None
+        full = frm
+    return f"{full}.{rest}" if rest else full
+
+
+def fn_scope(fi: FunctionInfo) -> str:
+    return fi.qualname.split(":", 1)[1]
+
+
+def static_name_prefix(arg: ast.AST) -> tuple[str | None, bool]:
+    """The statically-known part of a metric-name expression:
+    ``("name", True)`` for a full literal, ``("pre.", False)`` for an
+    f-string / ``"pre." + x`` concatenation with a literal head,
+    ``(None, False)`` when nothing is static."""
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value, True
+    if isinstance(arg, ast.JoinedStr):
+        if arg.values and isinstance(arg.values[0], ast.Constant):
+            return str(arg.values[0].value), False
+        return None, False
+    if isinstance(arg, ast.BinOp) and isinstance(arg.op, ast.Add):
+        left, full = static_name_prefix(arg.left)
+        if left is not None:
+            return left, False
+        return None, False
+    return None, False

@@ -1,0 +1,204 @@
+"""donation-discipline: donated buffers must not be read after the
+call — the STATIC twin of memscope's runtime donation audit (PR 12).
+
+``jax.jit(f, donate_argnums=(0,))(state)`` invalidates ``state``'s
+buffers the moment the call dispatches; reading ``state`` afterwards
+either crashes ("buffer has been deleted") or — worse, on backends
+where XLA declined the alias — silently reads a stale copy while the
+program pays the 2x footprint its donation claimed to eliminate. The
+runtime audit (``mem.donation_misses``) catches the second failure
+after the first execution; this rule catches both at review time.
+
+Two shapes are tracked per straight-line block:
+
+- ``g = jax.jit(f, donate_argnums=(0,))`` ... ``g(x)`` — ``x`` read
+  later in the block without an intervening rebind;
+- ``self._fn = ProgramSite(f, donate_argnums=(0,))`` in one method,
+  ``self._fn(x)`` in another method of the same class.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from fedml_tpu.analysis.core import (
+    Finding, JIT_ENTRY_NAMES, Project, register_rule, _terminal_name,
+)
+from fedml_tpu.analysis.rules._common import fn_scope
+
+_RULE = "donation-discipline"
+
+
+def _donate_argnums(call: ast.Call) -> tuple[int, ...] | None:
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            nums = []
+            for sub in ast.walk(kw.value):
+                if isinstance(sub, ast.Constant) \
+                        and isinstance(sub.value, int):
+                    nums.append(sub.value)
+            return tuple(nums)
+    return None
+
+
+def _is_donating_jit(call) -> tuple[int, ...] | None:
+    if isinstance(call, ast.Call) \
+            and _terminal_name(call.func) in JIT_ENTRY_NAMES:
+        return _donate_argnums(call)
+    return None
+
+
+@register_rule(
+    _RULE,
+    "an argument donated to a jit-compiled call is read again in the "
+    "same scope after the call (static twin of mem.donation audit)",
+)
+def check(project: Project) -> Iterator[Finding]:
+    for relpath, mod in sorted(project.modules.items()):
+        # module-level donating callables:
+        # `g = jax.jit(f, donate_argnums=(0,))` at module scope
+        module_donors: dict[str, tuple[int, ...]] = {}
+        for node in mod.tree.body:
+            if isinstance(node, ast.Assign):
+                donate = _is_donating_jit(node.value)
+                if donate:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            module_donors[t.id] = donate
+        # class-wide donating attributes:
+        # ("Cls", "_fn") -> donated argnums
+        attr_donors: dict[tuple[str, str], tuple[int, ...]] = {}
+        for qual, fi in mod.functions.items():
+            if fi.cls is None:
+                continue
+            for node in ast.walk(fi.node):
+                if not isinstance(node, ast.Assign):
+                    continue
+                donate = _is_donating_jit(node.value)
+                if not donate:
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id == "self":
+                        attr_donors[(fi.cls, t.attr)] = donate
+
+        for qual, fi in mod.functions.items():
+            if isinstance(fi.node, ast.Lambda):
+                continue
+            yield from _check_function(mod, fi, attr_donors,
+                                       module_donors)
+
+
+def _check_function(mod, fi, attr_donors, module_donors
+                    ) -> Iterator[Finding]:
+    scope = fn_scope(fi)
+
+    def blocks(node):
+        for field in ("body", "orelse", "finalbody"):
+            stmts = getattr(node, field, None)
+            if isinstance(stmts, list) and stmts \
+                    and isinstance(stmts[0], ast.stmt):
+                yield stmts
+        for child in ast.iter_child_nodes(node):
+            if not isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                yield from blocks(child)
+
+    for body in blocks(fi.node):
+        yield from _check_block(mod, fi, scope, body, attr_donors,
+                                module_donors)
+
+
+_SIMPLE_STMTS = (ast.Assign, ast.AnnAssign, ast.AugAssign, ast.Expr,
+                 ast.Return)
+
+
+def _check_block(mod, fi, scope, body, attr_donors, module_donors
+                 ) -> Iterator[Finding]:
+    # local donating callables bound in this block
+    local_donors: dict[str, tuple[int, ...]] = {}
+    # donated-away names -> line of the donating call
+    dead: dict[str, int] = {}
+    for stmt in body:
+        # reads of dead names anywhere in this statement's subtree
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Name) \
+                    and isinstance(node.ctx, ast.Load) \
+                    and node.id in dead:
+                yield Finding(
+                    rule=_RULE, path=mod.relpath, line=node.lineno,
+                    scope=scope,
+                    message=(
+                        f"`{node.id}` was donated to a "
+                        f"donate_argnums-compiled call and is read "
+                        f"afterwards — its buffers are deleted (or "
+                        f"silently undonated: mem.donation_misses)"
+                    ),
+                )
+                dead.pop(node.id, None)  # one finding per donation
+        # rebinds resurrect the name (conservatively, anywhere in the
+        # subtree: a rebind on one If branch must not leave the other
+        # branch's read flagged — branches may be exclusive)
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Name) \
+                    and isinstance(node.ctx, ast.Store):
+                dead.pop(node.id, None)
+
+        # donors are tracked from STRAIGHT-LINE statements only;
+        # nested If/For/With bodies are analyzed as their own blocks
+        # (a donate inside an early-return branch must not poison the
+        # sibling branch)
+        if not isinstance(stmt, _SIMPLE_STMTS):
+            continue
+
+        if isinstance(stmt, ast.Assign):
+            donate = _is_donating_jit(stmt.value)
+            if donate:
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        local_donors[t.id] = donate
+                continue
+
+        assigned = _assign_targets(stmt)
+        value = stmt.value
+        if value is None:
+            continue
+        for node in ast.walk(value):
+            if not isinstance(node, ast.Call):
+                continue
+            donate = None
+            f = node.func
+            if isinstance(f, ast.Name) and (
+                    f.id in local_donors or f.id in module_donors):
+                donate = local_donors.get(f.id) \
+                    or module_donors.get(f.id)
+            elif isinstance(f, ast.Attribute) \
+                    and isinstance(f.value, ast.Name) \
+                    and f.value.id == "self" and fi.cls is not None:
+                donate = attr_donors.get((fi.cls, f.attr))
+            elif _is_donating_jit(f):
+                donate = _is_donating_jit(f)  # jit(f, donate=..)(x)
+            if not donate:
+                continue
+            for idx in donate:
+                if idx < len(node.args) \
+                        and isinstance(node.args[idx], ast.Name):
+                    name = node.args[idx].id
+                    if name not in assigned:  # x = g(x) is the idiom
+                        dead[name] = node.lineno
+
+
+def _assign_targets(stmt) -> set[str]:
+    out: set[str] = set()
+    if isinstance(stmt, ast.Assign):
+        for t in stmt.targets:
+            for n in ast.walk(t):
+                if isinstance(n, ast.Name):
+                    out.add(n.id)
+    elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+        for n in ast.walk(stmt.target):
+            if isinstance(n, ast.Name):
+                out.add(n.id)
+    return out

@@ -1,0 +1,103 @@
+"""jit-purity: no host-impure work inside jit-reachable functions.
+
+The historical bug class: a ``time.time()`` or ``print`` inside a
+traced round body executes ONCE at trace time and never again (the
+metric silently freezes), ``random``/``np.random`` draws bake one
+sample into the executable (every round reuses it — the adversary
+injection and cohort sampling bugs PR 4/5 reviews hunted by hand), and
+``.item()`` / ``float()`` coercion forces a device sync in the middle
+of a compiled region. ``jax.random`` / ``jax.debug.print`` are the
+sanctioned replacements.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from fedml_tpu.analysis.core import Finding, Project, register_rule
+from fedml_tpu.analysis.rules._common import (
+    dotted_base, fn_scope, own_walk, resolve_module,
+)
+from fedml_tpu.analysis.rules.traced_branch import (
+    _is_static, _propagate,
+)
+
+#: modules whose every call is host-impure under trace
+IMPURE_MODULES = ("time", "random", "subprocess", "numpy.random",
+                  "socket")
+#: bare builtins that are host-impure under trace
+IMPURE_BUILTINS = {"print", "open", "input"}
+#: method calls that force a device->host sync on a traced value
+SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+
+
+@register_rule(
+    "jit-purity",
+    "host-impure calls (time/random/np.random/IO/print/.item()/float "
+    "coercion) inside functions reachable from a jit compile site",
+)
+def check(project: Project) -> Iterator[Finding]:
+    for qual in sorted(project.jit_reachable):
+        fi = project.functions.get(qual)
+        if fi is None or isinstance(fi.node, ast.Lambda):
+            continue
+        mod = fi.module
+        scope = fn_scope(fi)
+        # taint set for the sync-coercion checks: parameters are traced
+        # (conservatively — this IS a jit-reachable function), values
+        # derived only from shapes/dtypes/len() are not, so
+        # `int(x.shape[0] * f)` stays legal while `float(loss)` flags
+        args = fi.node.args
+        params = [a.arg for a in (args.posonlyargs + args.args
+                                  + args.kwonlyargs)]
+        if params and params[0] in ("self", "cls"):
+            params = params[1:]
+        traced = _propagate(fi.node, set(params))
+        for node in own_walk(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Name):
+                if f.id in IMPURE_BUILTINS:
+                    yield _finding(mod, node, scope,
+                                   f"host-impure call `{f.id}(...)`")
+                elif f.id in ("float", "int") and node.args \
+                        and not _is_static(node.args[0], traced):
+                    yield _finding(
+                        mod, node, scope,
+                        f"`{f.id}(...)` coercion forces a host sync on "
+                        f"a traced value",
+                    )
+                else:
+                    full = resolve_module(mod, f.id) or ""
+                    if _impure_module(full):
+                        yield _finding(mod, node, scope,
+                                       f"host-impure call `{full}`")
+            elif isinstance(f, ast.Attribute):
+                if f.attr in SYNC_METHODS \
+                        and not _is_static(f.value, traced):
+                    yield _finding(
+                        mod, node, scope,
+                        f"`.{f.attr}()` forces a host sync on a traced "
+                        f"value",
+                    )
+                    continue
+                dotted = dotted_base(f)
+                full = resolve_module(mod, dotted)
+                if full is not None and _impure_module(full):
+                    yield _finding(mod, node, scope,
+                                   f"host-impure call `{full}.{f.attr}`")
+
+
+def _impure_module(full: str) -> bool:
+    return any(full == m or full.startswith(m + ".")
+               for m in IMPURE_MODULES)
+
+
+def _finding(mod, node, scope: str, what: str) -> Finding:
+    return Finding(
+        rule="jit-purity", path=mod.relpath, line=node.lineno,
+        scope=scope,
+        message=f"{what} in jit-reachable `{scope}`",
+    )

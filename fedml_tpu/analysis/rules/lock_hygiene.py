@@ -1,0 +1,188 @@
+"""lock-hygiene: no blocking work while holding a lock, and no lock
+acquisition-order cycles.
+
+The historical bug class: the PR 11 time-series shutdown race (flush
+joining the flusher while appends held the lock) and the PR 4
+reputation race (admission on the dispatch thread vs the deadline
+timer) were both "blocking work sneaked under a lock" defects found in
+review. The rule flags calls that can block — socket send/recv/
+connect, ``serialize``/``seal``, orbax ``save``, ``time.sleep``,
+``subprocess`` invocations, thread ``.join()``, ``Event.wait()``,
+manager sends — LEXICALLY inside a ``with <lock>:`` body, and builds a
+lock-acquisition-order graph (edge A->B when B is taken while A is
+held) flagging cycles.
+
+Condition variables are exempt by name (``*_cv``/``*cond*``):
+``cv.wait()`` RELEASES the lock — that is its contract, not a bug.
+String ``sep.join(parts)`` is distinguished from thread joins by
+argument shape (``str.join`` always takes the iterable; a zero-arg or
+timeout-only ``.join()`` is a thread/process join).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from fedml_tpu.analysis.core import Finding, Project, register_rule
+from fedml_tpu.analysis.rules._common import (
+    dotted_base, fn_scope, resolve_module,
+)
+
+_RULE = "lock-hygiene"
+
+#: terminal call names that can block the holder
+BLOCKING = {
+    "sleep", "sendall", "send", "recv", "accept", "connect",
+    "create_connection", "serialize", "seal", "open_sealed", "save",
+    "wait", "send_message", "broadcast", "urlopen",
+}
+_SUBPROCESS = {"run", "call", "check_call", "check_output", "Popen"}
+
+
+def _lock_name(expr: ast.AST) -> str | None:
+    """Identify a with-context as a lock by name; None for non-locks
+    and for condition variables (whose wait() releases the lock)."""
+    text = ast.unparse(expr)
+    low = text.lower()
+    if "_cv" in low or "cond" in low:
+        return None
+    if "lock" in low or "mutex" in low:
+        # strip a .acquire-ish call / timeout decoration
+        return text.split("(")[0] if text.endswith(")") else text
+    return None
+
+
+@register_rule(
+    _RULE,
+    "blocking calls lexically inside a `with <lock>:` body, plus "
+    "lock-acquisition-order cycles across the project",
+)
+def check(project: Project) -> Iterator[Finding]:
+    # acquisition-order graph over normalized lock ids
+    order_edges: dict[tuple[str, str], tuple[str, int, str]] = {}
+    for relpath, mod in sorted(project.modules.items()):
+        for qual, fi in sorted(mod.functions.items()):
+            if isinstance(fi.node, ast.Lambda):
+                continue
+            scope = fn_scope(fi)
+            yield from _check_withs(mod, fi, scope, order_edges)
+    yield from _report_cycles(order_edges)
+
+
+def _check_withs(mod, fi, scope, order_edges) -> Iterator[Finding]:
+    def walk(node, held: list[str]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+                continue
+            if isinstance(child, ast.With):
+                locks = [
+                    _normalize(mod, fi, _lock_name(i.context_expr))
+                    for i in child.items
+                    if _lock_name(i.context_expr) is not None
+                ]
+                for lock in locks:
+                    for outer in held:
+                        if outer != lock:
+                            order_edges.setdefault(
+                                (outer, lock),
+                                (mod.relpath, child.lineno, scope),
+                            )
+                yield from walk(child, held + locks)
+                continue
+            if held and isinstance(child, ast.Call):
+                found = _blocking_reason(mod, child)
+                if found:
+                    yield Finding(
+                        rule=_RULE, path=mod.relpath,
+                        line=child.lineno, scope=scope,
+                        message=(
+                            f"blocking call `{found}` while holding "
+                            f"`{held[-1]}`"
+                        ),
+                    )
+            yield from walk(child, held)
+
+    yield from walk(fi.node, [])
+
+
+def _normalize(mod, fi, lock_text: str | None) -> str:
+    """`self._lock` -> "Cls._lock" so the order graph spans methods;
+    bare names scope to the module."""
+    if lock_text is None:
+        return ""
+    if lock_text.startswith("self.") and fi.cls:
+        return f"{fi.cls}{lock_text[4:]}"
+    if "." not in lock_text:
+        return f"{mod.modname}:{lock_text}"
+    return lock_text
+
+
+def _blocking_reason(mod, call: ast.Call) -> str | None:
+    f = call.func
+    if isinstance(f, ast.Name):
+        full = resolve_module(mod, f.id) or ""
+        if full.startswith("time.sleep") or full == "subprocess.Popen":
+            return full
+        return None
+    if not isinstance(f, ast.Attribute):
+        return None
+    base = dotted_base(f)
+    full = resolve_module(mod, base) or (base or "")
+    if f.attr in _SUBPROCESS and full.startswith("subprocess"):
+        return f"subprocess.{f.attr}"
+    if f.attr == "join":
+        # str.join always takes the iterable; a 0-arg or timeout-only
+        # join is a thread/process join
+        if not call.args and not call.keywords:
+            return f"{base or '<obj>'}.join"
+        if call.keywords and all(k.arg == "timeout"
+                                 for k in call.keywords):
+            return f"{base or '<obj>'}.join"
+        if len(call.args) == 1 and isinstance(call.args[0],
+                                              ast.Constant) \
+                and isinstance(call.args[0].value, (int, float)):
+            return f"{base or '<obj>'}.join"
+        return None
+    if f.attr in BLOCKING:
+        if f.attr == "sleep" and not (full.startswith("time")
+                                      or base is None):
+            return None
+        if f.attr == "wait" and base is not None:
+            # Condition.wait() RELEASES the lock — exempt receivers
+            # that read as condition variables, matching the
+            # with-context exemption
+            low = base.lower()
+            if "_cv" in low or "cond" in low:
+                return None
+        return f"{base + '.' if base else ''}{f.attr}"
+    return None
+
+
+def _report_cycles(order_edges) -> Iterator[Finding]:
+    graph: dict[str, set[str]] = {}
+    for (a, b) in order_edges:
+        graph.setdefault(a, set()).add(b)
+    seen_cycles: set[tuple[str, ...]] = set()
+    for start in sorted(graph):
+        stack = [(start, [start])]
+        while stack:
+            node, path = stack.pop()
+            for nxt in sorted(graph.get(node, ())):
+                if nxt == start:
+                    cyc = tuple(sorted(path))
+                    if cyc in seen_cycles:
+                        continue
+                    seen_cycles.add(cyc)
+                    relpath, line, scope = order_edges[(node, start)]
+                    yield Finding(
+                        rule=_RULE, path=relpath, line=line,
+                        scope=scope,
+                        message=(
+                            "lock acquisition-order cycle: "
+                            + " -> ".join(path + [start])
+                        ),
+                    )
+                elif nxt not in path and len(path) < 6:
+                    stack.append((nxt, path + [nxt]))

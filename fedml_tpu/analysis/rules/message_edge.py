@@ -1,0 +1,152 @@
+"""message-edge: every message-type constant is a complete edge.
+
+A message type is three obligations, not one: a HANDLER registration
+(an unhandled type is dropped silently by the manager's dispatch), a
+``MSG_TYPE_NAMES`` entry (the per-type byte counters
+``transport.bytes_by_type.<name>`` fall back to a bare integer —
+PR 7's wire-reduction accounting becomes unreadable), and payload
+access behind the receive-edge validation discipline every inbound
+payload follows (compress.validate_payload / tier.validate_partial:
+``msg.get(...)`` with explicit screening — never a raw
+``msg.payload[...]`` subscript that KeyErrors the dispatch thread on
+a malformed frame).
+
+Constants are recognized by shape: module-level ``MSG_*`` names bound
+to integer literals (``MSG_TYPE_C2S_RESULT = 3``,
+``MSG_SNN_ACTS = 101``).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from fedml_tpu.analysis.core import (
+    Finding, Project, _terminal_name, register_rule,
+)
+
+_RULE = "message-edge"
+_CONST_RE = re.compile(r"^MSG_[A-Z0-9_]+$")
+
+
+@register_rule(
+    _RULE,
+    "every MSG_* message-type constant needs a handler registration, "
+    "a MSG_TYPE_NAMES entry, and validated payload access in handlers",
+)
+def check(project: Project) -> Iterator[Finding]:
+    consts: dict[str, tuple[str, int]] = {}  # name -> (path, line)
+    handled: set[str] = set()
+    named: set[str] = set()
+    handler_fns: list[tuple[str, str]] = []  # (modpath, fn simple name)
+
+    for relpath, mod in sorted(project.modules.items()):
+        for node in mod.tree.body:  # module level only
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and _CONST_RE.match(node.targets[0].id) \
+                    and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, int):
+                consts[node.targets[0].id] = (mod.relpath, node.lineno)
+
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = node.func.attr \
+                if isinstance(node.func, ast.Attribute) else (
+                    node.func.id if isinstance(node.func, ast.Name)
+                    else None)
+            if fname == "register_message_receive_handler" and node.args:
+                cname = _const_name(node.args[0])
+                if cname:
+                    handled.add(cname)
+                if len(node.args) > 1:
+                    h = node.args[1]
+                    if isinstance(h, ast.Attribute):
+                        handler_fns.append((mod.relpath, h.attr))
+                    elif isinstance(h, ast.Name):
+                        handler_fns.append((mod.relpath, h.id))
+            elif (fname == "update" and node.args
+                    and isinstance(node.func, ast.Attribute)
+                    and _terminal_name(node.func.value)
+                    == "MSG_TYPE_NAMES"
+                    and isinstance(node.args[0], ast.Dict)):
+                for k in node.args[0].keys:
+                    cname = _const_name(k)
+                    if cname:
+                        named.add(cname)
+
+        # MSG_TYPE_NAMES = { CONST: "name", ... } literal
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Assign) \
+                    and any(_terminal_name(t) == "MSG_TYPE_NAMES"
+                            for t in node.targets) \
+                    and isinstance(node.value, ast.Dict):
+                for k in node.value.keys:
+                    cname = _const_name(k)
+                    if cname:
+                        named.add(cname)
+
+    for cname, (path, line) in sorted(consts.items()):
+        if cname not in handled:
+            yield Finding(
+                rule=_RULE, path=path, line=line, scope="<module>",
+                message=(
+                    f"message type {cname} has no "
+                    f"register_message_receive_handler site — inbound "
+                    f"frames of this type are dropped silently"
+                ),
+            )
+        if cname not in named:
+            yield Finding(
+                rule=_RULE, path=path, line=line, scope="<module>",
+                message=(
+                    f"message type {cname} has no MSG_TYPE_NAMES "
+                    f"entry — transport.bytes_by_type falls back to a "
+                    f"bare integer for it"
+                ),
+            )
+
+    # raw payload subscripts inside registered handlers — matched by
+    # simple name WITHIN the registering module only (a same-named
+    # function elsewhere in the project is not this handler)
+    seen_handlers: set[tuple[str, str]] = set()
+    for modpath, fname in handler_fns:
+        for qual, fi in project.functions.items():
+            if fi.name != fname or fi.module.relpath != modpath:
+                continue
+            key = (fi.module.relpath, qual)
+            if key in seen_handlers:
+                continue
+            seen_handlers.add(key)
+            node = fi.node
+            if isinstance(node, ast.Lambda):
+                continue
+            args = [a.arg for a in node.args.args]
+            msg_params = {a for a in args if a not in ("self", "cls")}
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Subscript) \
+                        and isinstance(sub.value, ast.Attribute) \
+                        and sub.value.attr == "payload" \
+                        and isinstance(sub.value.value, ast.Name) \
+                        and sub.value.value.id in msg_params \
+                        and isinstance(sub.ctx, ast.Load):
+                    scope = qual.split(":", 1)[1]
+                    yield Finding(
+                        rule=_RULE, path=fi.module.relpath,
+                        line=sub.lineno, scope=scope,
+                        message=(
+                            f"raw payload subscript in receive "
+                            f"handler `{scope}` — a malformed frame "
+                            f"KeyErrors the dispatch thread; use "
+                            f".get() behind receive-edge validation"
+                        ),
+                    )
+
+
+def _const_name(expr) -> str | None:
+    name = _terminal_name(expr)
+    if name is not None and _CONST_RE.match(name):
+        return name
+    return None

@@ -1,0 +1,286 @@
+"""metric-vocabulary: code and docs/OBSERVABILITY.md must agree,
+bidirectionally.
+
+The observability plane's contract (PR 2 onward) is that every metric
+name is a documented vocabulary row — operators alert on names, and an
+undocumented name is invisible to them (the historical instances this
+PR fixes: ``perf.profile.window_s`` and ``recovery.rejoins_reconciled``
+were written by the runtime but absent from the tables). The rule
+parses every ``| name | kind | meaning |`` table in the vocabulary doc
+into patterns (``<...>`` placeholders become wildcards, ``{a,b}``
+braces and ``a/b`` slash-runs expand) and checks both directions:
+
+- every string literal (or f-string/concat literal PREFIX) passed to
+  ``inc``/``gauge``/``observe``/``gauge_labeled``/``labeled_name``/
+  ``merge_histogram`` on a metrics registry must match a documented
+  family;
+- every documented family must have at least one write site in the
+  analyzed code (a stale table row is a lie operators will alert on) —
+  families written by infrastructure the analyzer cannot see through
+  are declared in ``fedlint.json`` ``options.metric-vocabulary.
+  assume_written``.
+
+The doc->code direction is only meaningful when the scan actually
+covers the runtime: linting a subtree (``fedlint scripts/``) must not
+indict every row whose writer lives elsewhere. Default gating
+(``options.metric-vocabulary.reverse: "auto"``): the stale-row checks
+run when the analyzed modules include the metrics-registry
+implementation (a ``class MetricsRegistry`` definition — scanning the
+telemetry spine means scanning the runtime). ``"always"``/``"never"``
+override.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Iterator
+
+from fedml_tpu.analysis.core import Finding, Project, register_rule
+from fedml_tpu.analysis.rules._common import static_name_prefix
+
+_RULE = "metric-vocabulary"
+_WRITE_METHODS = {"inc", "gauge", "observe", "gauge_labeled",
+                  "labeled_name", "merge_histogram"}
+_HEADER_RE = re.compile(r"^\|\s*name\s*\|\s*kind\s*\|", re.IGNORECASE)
+_TOKEN_RE = re.compile(r"`([^`]+)`")
+
+
+class _Pattern:
+    def __init__(self, raw: str, line: int):
+        self.raw = raw
+        self.line = line
+        self.literal_prefix = raw.split("<", 1)[0]
+        self.has_wildcard = "<" in raw
+        rx = "".join(
+            ".+" if part.startswith("<") else re.escape(part)
+            for part in re.split(r"(<[^>]*>)", raw)
+        )
+        self.regex = re.compile(rx + r"\Z")
+        self.satisfied = False
+
+    def matches_exact(self, name: str) -> bool:
+        return self.regex.match(name) is not None
+
+    def matches_prefix(self, prefix: str) -> bool:
+        """A dynamic write with literal head ``prefix`` may produce a
+        name of this family — but only when the head ends at a FAMILY
+        BOUNDARY (a ``.``): without that, ``f"rec{kind}"`` would
+        satisfy `recovery.resumes` and one sloppy ``f"perf.{x}"``
+        write would mark every perf row written."""
+        if self.has_wildcard:
+            lit = self.literal_prefix
+            if prefix.startswith(lit):
+                return True  # head reaches into the wildcard
+            return lit.startswith(prefix) and _boundary(lit, prefix)
+        return self.raw.startswith(prefix) \
+            and _boundary(self.raw, prefix)
+
+
+def _boundary(longer: str, prefix: str) -> bool:
+    """True when ``prefix`` ends at a dotted-name boundary of
+    ``longer`` (equal, ends with '.', or the next char is '.')."""
+    return len(longer) == len(prefix) or prefix.endswith(".") \
+        or longer[len(prefix)] == "."
+
+
+def _expand_cell(cell: str, line: int) -> list[_Pattern]:
+    out: list[_Pattern] = []
+    for token in _TOKEN_RE.findall(cell):
+        for name in _expand_token(token):
+            out.append(_Pattern(name, line))
+    return out
+
+
+def _expand_token(token: str) -> list[str]:
+    # slash-run alternation: "chaos.dropped/delayed/..." — the first
+    # element carries the dotted prefix the rest inherit
+    if "/" in token:
+        parts = token.split("/")
+        head = parts[0]
+        prefix = head[: head.rfind(".") + 1] if "." in head else ""
+        expanded = [head] + [prefix + p for p in parts[1:]]
+        return [n for p in expanded for n in _expand_token(p)] \
+            if "{" in token else expanded
+    # brace alternation: "perf.profile.{compute,idle}_frac"
+    m = re.search(r"\{([^{}]*)\}", token)
+    if m:
+        out = []
+        for alt in m.group(1).split(","):
+            out.extend(_expand_token(token[: m.start()] + alt
+                                     + token[m.end():]))
+        return out
+    return [token]
+
+
+def _scope_covers_runtime(project: Project) -> bool:
+    """True when the scan includes the metrics-registry implementation
+    — the sentinel that the runtime (and so the writers the doc rows
+    describe) is actually inside the analyzed tree."""
+    for mod in project.modules.values():
+        for node in mod.tree.body:
+            if isinstance(node, ast.ClassDef) \
+                    and node.name == "MetricsRegistry":
+                return True
+    return False
+
+
+def _load_vocabulary(project: Project) -> tuple[str, list[_Pattern]]:
+    doc_rel = project.config.vocabulary_doc
+    doc_path = os.path.join(project.root, doc_rel)
+    patterns: list[_Pattern] = []
+    if not os.path.exists(doc_path):
+        return doc_rel, patterns
+    with open(doc_path, encoding="utf-8") as f:
+        lines = f.read().splitlines()
+    in_table = False
+    for i, line in enumerate(lines, start=1):
+        stripped = line.strip()
+        if _HEADER_RE.match(stripped):
+            in_table = True
+            continue
+        if in_table:
+            if not stripped.startswith("|"):
+                in_table = False
+                continue
+            if set(stripped) <= {"|", "-", " "}:
+                continue  # the |---|---| separator
+            cell = stripped.strip("|").split("|", 1)[0]
+            patterns.extend(_expand_cell(cell, i))
+    return doc_rel.replace(os.sep, "/"), patterns
+
+
+def _iter_metric_writes(project: Project):
+    """Yield ``(mod, call, name_or_prefix, is_exact, scope)`` for every
+    registry write whose name has a statically-known part."""
+    for relpath, mod in sorted(project.modules.items()):
+        registry_locals = _registry_locals(mod)
+        helpers = _name_helpers(mod)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) \
+                    or not isinstance(node.func, ast.Attribute) \
+                    or node.func.attr not in _WRITE_METHODS \
+                    or not node.args:
+                continue
+            base = node.func.value
+            base_text = ast.unparse(base)
+            low = base_text.lower()
+            if not (base_text.endswith("METRICS")
+                    or base_text in registry_locals
+                    or "registry" in low or "metrics" in low):
+                continue
+            name, exact = static_name_prefix(node.args[0])
+            if name is None:
+                # a helper call returning an f-string name
+                # (`m.inc(_bytes_by_type_metric(t), n)`) contributes
+                # the helper's literal prefix
+                arg = node.args[0]
+                if isinstance(arg, ast.Call) \
+                        and isinstance(arg.func, ast.Name) \
+                        and arg.func.id in helpers:
+                    name, exact = helpers[arg.func.id]
+                else:
+                    continue
+            if node.func.attr in ("gauge_labeled", "labeled_name"):
+                # the written name is family + sep + label
+                name, exact = name + ".", False
+            scope = mod.enclosing_function(node.lineno)
+            yield mod, node, name, exact, scope
+
+
+def _registry_locals(mod) -> set[str]:
+    """Names bound from a registry value (``m = telemetry.METRICS``,
+    ``m = self._registry``, ``m = registry or telemetry.METRICS``)."""
+    out: set[str] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Assign):
+            text = ast.unparse(node.value).lower()
+            if text.endswith("metrics") or "registry" in text \
+                    or "metrics" in text:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+    return out
+
+
+def _name_helpers(mod) -> dict[str, tuple[str, bool]]:
+    """Module functions that produce a metric name with a literal
+    dotted prefix — base.py's ``_bytes_by_type_metric`` idiom (the
+    f-string may be cached through a dict, so every string-producing
+    expression in the body is considered; the helper qualifies when
+    they all agree on ONE prefix)."""
+    out: dict[str, tuple[str, bool]] = {}
+    for qual, fi in mod.functions.items():
+        node = fi.node
+        if isinstance(node, ast.Lambda) or fi.cls is not None:
+            continue
+        prefixes: dict[str, bool] = {}
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.JoinedStr, ast.Constant)):
+                name, exact = static_name_prefix(sub)
+                if name is not None and "." in name \
+                        and re.fullmatch(r"[a-z_][a-zA-Z0-9_.]*",
+                                         name):
+                    # a JoinedStr and its own inner Constant both
+                    # surface; the prefix (non-exact) claim wins
+                    prefixes[name] = prefixes.get(name, True) and exact
+        if len(prefixes) == 1:
+            name, exact = next(iter(prefixes.items()))
+            out[fi.name] = (name, exact)
+    return out
+
+
+@register_rule(
+    _RULE,
+    "every metric written to the registry must match a documented "
+    "vocabulary row in docs/OBSERVABILITY.md, and every documented "
+    "row must have a write site (bidirectional, prefix-wildcard "
+    "families supported)",
+)
+def check(project: Project) -> Iterator[Finding]:
+    doc_rel, patterns = _load_vocabulary(project)
+    if not patterns:
+        return  # no vocabulary doc in this tree: nothing to check
+    opts = project.config.options.get(_RULE, {})
+    assume = set(opts.get("assume_written", ()))
+    for pat in patterns:
+        if any(pat.matches_exact(a) or a == pat.raw for a in assume):
+            pat.satisfied = True
+
+    for mod, node, name, exact, scope in _iter_metric_writes(project):
+        hit = False
+        for pat in patterns:
+            ok = pat.matches_exact(name) if exact \
+                else pat.matches_prefix(name)
+            if ok:
+                pat.satisfied = True
+                hit = True
+        if not hit:
+            shown = name if exact else f"{name}*"
+            yield Finding(
+                rule=_RULE, path=mod.relpath, line=node.lineno,
+                scope=scope,
+                message=(
+                    f"metric `{shown}` is not in the "
+                    f"{doc_rel} vocabulary tables — add a row or "
+                    f"rename to a documented family"
+                ),
+            )
+
+    reverse = opts.get("reverse", "auto")
+    if reverse == "never" or (reverse == "auto"
+                              and not _scope_covers_runtime(project)):
+        return
+    for pat in patterns:
+        if not pat.satisfied:
+            yield Finding(
+                rule=_RULE, path=doc_rel, line=pat.line,
+                scope="<vocabulary>",
+                message=(
+                    f"documented metric family `{pat.raw}` has no "
+                    f"write site in the analyzed code — stale row, or "
+                    f"add it to options.metric-vocabulary."
+                    f"assume_written"
+                ),
+            )

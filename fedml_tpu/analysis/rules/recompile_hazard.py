@@ -1,0 +1,158 @@
+"""recompile-hazard: jit programs that silently recompile.
+
+Two shapes the elastic work (PR 5) and round fusion (PR 10) exist to
+prevent:
+
+- a raw compile site (``jax.jit``/``ProgramSite``/``shard_map``)
+  invoked LEXICALLY inside a loop body — every iteration traces and
+  compiles a fresh executable (``CompiledRoundCache`` is exempt: being
+  called per round while caching per bucket is its whole point);
+- a nested function or lambda handed to a compile site while closing
+  over a visibly-mutable enclosing value (a name bound to a
+  list/dict/set literal or constructor in the enclosing scope): the
+  closure is not hashable state jit can key on, so mutation between
+  calls changes numerics WITHOUT a recompile — the inverse failure,
+  just as silent.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from fedml_tpu.analysis.core import (
+    Finding, Project, _terminal_name as _terminal, register_rule,
+)
+
+_RULE = "recompile-hazard"
+_LOOPY_ENTRIES = {"jit", "pjit", "ProgramSite"}
+_MUTABLE_CTORS = {"list", "dict", "set", "bytearray", "defaultdict",
+                  "OrderedDict", "deque"}
+
+
+@register_rule(
+    _RULE,
+    "jit compile sites inside loop bodies (a recompile per iteration) "
+    "and jit-compiled closures over mutable Python values (numerics "
+    "change without a recompile)",
+)
+def check(project: Project) -> Iterator[Finding]:
+    for relpath, mod in sorted(project.modules.items()):
+        yield from _loops(mod)
+        yield from _closures(mod)
+
+
+def _loops(mod) -> Iterator[Finding]:
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.For, ast.While)):
+            continue
+        # manual stack walk PRUNING nested defs/lambdas: their bodies
+        # execute when the stored callable is called, not per
+        # iteration (ast.walk + `continue` would still descend)
+        stack = list(ast.iter_child_nodes(node))
+        while stack:
+            sub = stack.pop()
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                continue
+            stack.extend(ast.iter_child_nodes(sub))
+            # only IMMEDIATE invocation retraces per iteration —
+            # `jax.jit(f)(x)` in a loop. Building jitted callables in a
+            # setup loop (one per bucket, stored) compiles lazily once
+            # per callable and is the elastic idiom, not the hazard.
+            if isinstance(sub, ast.Call) \
+                    and isinstance(sub.func, ast.Call) \
+                    and _terminal(sub.func.func) in _LOOPY_ENTRIES \
+                    and sub.func.args:
+                scope = mod.enclosing_function(sub.lineno)
+                yield Finding(
+                    rule=_RULE, path=mod.relpath, line=sub.lineno,
+                    scope=scope,
+                    message=(
+                        f"`{_terminal(sub.func.func)}(...)(...)` "
+                        f"invoked inside a loop body traces+compiles "
+                        f"every iteration — hoist the compile site or "
+                        f"use CompiledRoundCache"
+                    ),
+                )
+
+
+def _closures(mod) -> Iterator[Finding]:
+    for qual, fi in sorted(mod.functions.items()):
+        node = fi.node
+        if isinstance(node, ast.Lambda):
+            continue
+        # names bound to mutable containers in THIS function's body
+        mutable: set[str] = set()
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign):
+                val = sub.value
+                is_mut = isinstance(val, (ast.List, ast.Dict, ast.Set,
+                                          ast.ListComp, ast.DictComp,
+                                          ast.SetComp)) or (
+                    isinstance(val, ast.Call)
+                    and _terminal(val.func) in _MUTABLE_CTORS
+                )
+                if is_mut:
+                    for t in sub.targets:
+                        if isinstance(t, ast.Name):
+                            mutable.add(t.id)
+        if not mutable:
+            continue
+        # nested callables handed to a compile site
+        nested_defs = {n.name: n for n in ast.walk(node)
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))
+                       and n is not node}
+        for sub in ast.walk(node):
+            if not (isinstance(sub, ast.Call)
+                    and _terminal(sub.func) in _LOOPY_ENTRIES | {
+                        "shard_map", "CompiledRoundCache"}
+                    and sub.args):
+                continue
+            fn_arg = sub.args[0]
+            target = None
+            if isinstance(fn_arg, ast.Lambda):
+                target = fn_arg
+            elif isinstance(fn_arg, ast.Name) \
+                    and fn_arg.id in nested_defs:
+                target = nested_defs[fn_arg.id]
+            if target is None:
+                continue
+            bound = _bound_names(target)
+            frees = {
+                n.id for n in ast.walk(target)
+                if isinstance(n, ast.Name)
+                and isinstance(n.ctx, ast.Load)
+                and n.id in mutable and n.id not in bound
+            }
+            for name in sorted(frees):
+                scope = mod.enclosing_function(sub.lineno)
+                yield Finding(
+                    rule=_RULE, path=mod.relpath, line=sub.lineno,
+                    scope=scope,
+                    message=(
+                        f"jit-compiled closure captures mutable "
+                        f"`{name}` — mutation between calls changes "
+                        f"numerics without a recompile; pass it as an "
+                        f"operand or freeze it (tuple/frozen "
+                        f"dataclass)"
+                    ),
+                )
+
+
+def _bound_names(fn_node) -> set[str]:
+    out: set[str] = set()
+    if isinstance(fn_node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+        a = fn_node.args
+        for arg in (a.posonlyargs + a.args + a.kwonlyargs):
+            out.add(arg.arg)
+        if a.vararg:
+            out.add(a.vararg.arg)
+        if a.kwarg:
+            out.add(a.kwarg.arg)
+    for sub in ast.walk(fn_node):
+        if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Store):
+            out.add(sub.id)
+    return out

@@ -1,0 +1,144 @@
+"""traced-branch: Python control flow on traced values.
+
+A Python ``if``/``while``/``assert`` over a value derived from a traced
+parameter raises ``TracerBoolConversionError`` at best; at worst (when
+the branch happens to be concretizable at trace time) it bakes ONE
+branch into the executable — the class of bug PR 5's elastic masking
+review kept finding (``lax.cond`` / ``jnp.where`` / ``lax.while_loop``
+are the traced forms). Checked on DIRECT jit roots, where the
+parameter<->tracer correspondence is known exactly: parameters minus
+the call site's ``static_argnums``/``static_argnames`` are traced, and
+taint propagates through straight-line assignments.
+
+Shape/dtype/identity tests stay legal: ``x.shape``/``x.ndim``/
+``x.dtype``/``x.size``, ``len(x)``, ``isinstance(x, ...)`` and
+``is (not) None`` comparisons are static under trace.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from fedml_tpu.analysis.core import Finding, Project, register_rule
+from fedml_tpu.analysis.rules._common import own_walk
+
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+_STATIC_CALLS = {"len", "isinstance", "hasattr", "getattr", "type"}
+
+
+@register_rule(
+    "traced-branch",
+    "Python if/while/assert on values derived from traced parameters "
+    "of a function compiled by jax.jit/ProgramSite/shard_map",
+)
+def check(project: Project) -> Iterator[Finding]:
+    for qual, static_names in sorted(project.jit_roots.items()):
+        fi = project.functions.get(qual)
+        if fi is None or isinstance(fi.node, ast.Lambda):
+            continue
+        node = fi.node
+        params = [a.arg for a in (node.args.posonlyargs + node.args.args
+                                  + node.args.kwonlyargs)]
+        if params and params[0] in ("self", "cls"):
+            params = params[1:]
+        traced = {p for p in params if p not in static_names}
+        if not traced:
+            continue
+        traced = _propagate(node, traced)
+        scope = qual.split(":", 1)[1]
+        for sub in own_walk(node):
+            test = None
+            kind = None
+            if isinstance(sub, (ast.If, ast.While)):
+                test, kind = sub.test, type(sub).__name__.lower()
+            elif isinstance(sub, ast.Assert):
+                test, kind = sub.test, "assert"
+            elif isinstance(sub, ast.IfExp):
+                test, kind = sub.test, "conditional expression"
+            if test is None or _is_static(test, traced):
+                continue
+            names = sorted(_traced_names(test, traced))
+            yield Finding(
+                rule="traced-branch", path=fi.module.relpath,
+                line=sub.lineno, scope=scope,
+                message=(
+                    f"python {kind} on traced value(s) "
+                    f"{', '.join(names)} in jit-compiled `{scope}` — "
+                    f"use lax.cond/jnp.where/lax.while_loop"
+                ),
+            )
+
+
+def _propagate(fn_node: ast.AST, traced: set[str]) -> set[str]:
+    """Fixpoint taint propagation through assignments in the body."""
+    for _ in range(10):
+        grew = False
+        for sub in own_walk(fn_node):
+            targets = None
+            value = None
+            if isinstance(sub, ast.Assign):
+                targets, value = sub.targets, sub.value
+            elif isinstance(sub, ast.AnnAssign) and sub.value is not None:
+                targets, value = [sub.target], sub.value
+            elif isinstance(sub, ast.AugAssign):
+                targets, value = [sub.target], sub.value
+            if value is None or _is_static(value, traced):
+                continue
+            for t in targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name) and n.id not in traced:
+                        traced.add(n.id)
+                        grew = True
+        if not grew:
+            break
+    return traced
+
+
+def _traced_names(expr: ast.AST, traced: set[str]) -> set[str]:
+    return {n.id for n in ast.walk(expr)
+            if isinstance(n, ast.Name) and n.id in traced}
+
+
+def _is_static(expr: ast.AST, traced: set[str]) -> bool:
+    """True when the expression cannot carry traced DATA: constants,
+    untraced names, shape/dtype attributes, len()/isinstance() calls,
+    `is None` identity tests, and compositions thereof."""
+    if isinstance(expr, ast.Constant):
+        return True
+    if isinstance(expr, ast.Name):
+        return expr.id not in traced
+    if isinstance(expr, ast.Attribute):
+        return expr.attr in _STATIC_ATTRS or _is_static(expr.value,
+                                                        traced)
+    if isinstance(expr, ast.Call):
+        fname = None
+        if isinstance(expr.func, ast.Name):
+            fname = expr.func.id
+        elif isinstance(expr.func, ast.Attribute):
+            fname = expr.func.attr
+        if fname in _STATIC_CALLS:
+            return True
+        return all(_is_static(a, traced) for a in expr.args) and \
+            _is_static(expr.func, traced)
+    if isinstance(expr, ast.Compare):
+        if all(isinstance(op, (ast.Is, ast.IsNot)) for op in expr.ops):
+            return True
+        return _is_static(expr.left, traced) and all(
+            _is_static(c, traced) for c in expr.comparators
+        )
+    if isinstance(expr, ast.BoolOp):
+        return all(_is_static(v, traced) for v in expr.values)
+    if isinstance(expr, ast.UnaryOp):
+        return _is_static(expr.operand, traced)
+    if isinstance(expr, ast.BinOp):
+        return _is_static(expr.left, traced) and \
+            _is_static(expr.right, traced)
+    if isinstance(expr, ast.Subscript):
+        return _is_static(expr.value, traced) and \
+            _is_static(expr.slice, traced)
+    if isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+        return all(_is_static(e, traced) for e in expr.elts)
+    # unknown expression kinds: only flag when a traced name is visibly
+    # inside (conservative against false positives)
+    return not _traced_names(expr, traced)

@@ -34,7 +34,11 @@ class _TensorRef:
 
 # Well-known message types (reference message_define.py files use small int
 # enums per algorithm; we reserve a shared space for the built-in flows).
-MSG_TYPE_S2C_INIT = 1
+# Type 1 was MSG_TYPE_S2C_INIT, minted mirroring the reference's init
+# broadcast but never sent nor handled by any flow here — the fedlint
+# message-edge rule flagged the dead edge and it was removed; the
+# integer stays reserved so a future type cannot collide with frames
+# from an old build.
 MSG_TYPE_S2C_SYNC_MODEL = 2
 MSG_TYPE_C2S_RESULT = 3
 MSG_TYPE_FINISH = 4
@@ -85,7 +89,6 @@ MSG_TYPE_L2R_PARTIAL = 11
 #: (``c2s_result``) specifically — heartbeats/ACKs ride the same sealed
 #: frames and would otherwise pollute the measurement.
 MSG_TYPE_NAMES = {
-    MSG_TYPE_S2C_INIT: "s2c_init",
     MSG_TYPE_S2C_SYNC_MODEL: "s2c_sync_model",
     MSG_TYPE_C2S_RESULT: "c2s_result",
     MSG_TYPE_FINISH: "finish",

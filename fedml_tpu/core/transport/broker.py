@@ -350,6 +350,13 @@ class RemoteTopicBus:
                         # resending would double-subscribe
                         return
                 try:
+                    # fedlint: disable=lock-hygiene  _wlock IS the
+                    # frame serializer: one socket, whole frames — a
+                    # send outside it could interleave with a redial's
+                    # SUB replay and corrupt the stream. Nothing else
+                    # ever waits on _wlock holders (publish/subscribe
+                    # are the only takers), so the block is bounded by
+                    # the socket timeout, not a deadlock risk.
                     self._sock.sendall(data)
                     return
                 except OSError as err:

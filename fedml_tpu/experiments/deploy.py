@@ -1010,6 +1010,14 @@ class Supervisor:
     def _spawn(self, rank: int, argv: list[str]) -> None:
         import subprocess
 
+        from fedml_tpu.analysis.flags import check_rank_argv
+
+        # one registration contract across run.py/bench.py/this
+        # supervisor (fedml_tpu/analysis/flags.py): a client argv
+        # carrying a rank-0-only bind flag (--metrics_port) means the
+        # caller built its RankSpecs without run.py's strip — fail at
+        # spawn, not at N clients fighting over one port
+        check_rank_argv(argv, rank)
         n = len(self.log_paths[rank])
         path = os.path.join(self.log_dir, f"rank{rank}_try{n}.log")
         fh = open(path, "w")

@@ -49,6 +49,23 @@ def parse_args(argv=None) -> tuple[ExperimentConfig, argparse.Namespace]:
     p.add_argument("--batch_size", type=int, default=None)
     p.add_argument("--lr", type=float, default=None)
     p.add_argument("--client_optimizer", type=str, default=None)
+    # -- server-side optimization (FedOpt family; fedavg.py
+    # make_server_optimizer). Previously settable ONLY by hand-editing
+    # a --config JSON, which bypassed parse-time validation — the
+    # fedlint parse-time-validation rule flagged the gap
+    # (docs/STATIC_ANALYSIS.md).
+    p.add_argument("--server_optimizer", type=str, default=None,
+                   choices=["sgd", "adam", "adagrad", "yogi"],
+                   help="server-side optimizer applied to the "
+                        "aggregated delta (FedOpt; 'sgd' with "
+                        "--server_lr 1.0 == plain FedAvg)")
+    p.add_argument("--server_lr", type=float, default=None,
+                   help="server optimizer learning rate (> 0)")
+    p.add_argument("--server_momentum", type=float, default=None,
+                   help="server SGD momentum (in [0, 1))")
+    p.add_argument("--gmf", type=float, default=None,
+                   help="FedNova global momentum factor (in [0, 1); "
+                        "0 disables the momentum buffer)")
     p.add_argument("--compute_dtype", type=str, default=None,
                    choices=["float32", "bfloat16"],
                    help="mixed-precision compute dtype (params stay f32)")
@@ -393,6 +410,15 @@ def parse_args(argv=None) -> tuple[ExperimentConfig, argparse.Namespace]:
                    choices=["silent", "exit"],
                    help="silent: the rank stops communicating; exit: "
                         "the process dies (os._exit) like kill -9")
+    # the shared registration checker (fedml_tpu/analysis/flags.py):
+    # run.py OWNS the reserved --slo/--metrics_port names, so owner
+    # mode asserts they are registered AND nothing is duplicated —
+    # bench.py and the supervisor run the non-owner side of the same
+    # contract
+    from fedml_tpu.analysis.flags import check_flag_registry
+
+    check_flag_registry(p, owner=True,
+                        entrypoint="fedml_tpu.experiments.run")
     a = p.parse_args(argv)
 
     if a.config:
@@ -437,6 +463,10 @@ def parse_args(argv=None) -> tuple[ExperimentConfig, argparse.Namespace]:
             num_rounds=a.comm_round,
             clients_per_round=a.client_num_per_round,
             eval_every=a.frequency_of_the_test,
+            server_optimizer=a.server_optimizer,
+            server_lr=a.server_lr,
+            server_momentum=a.server_momentum,
+            gmf=a.gmf,
             robust_method=a.defense or a.robust_method,
             robust_norm_clip=a.robust_norm_clip,
             robust_noise_stddev=a.robust_noise_stddev,
@@ -486,6 +516,28 @@ def parse_args(argv=None) -> tuple[ExperimentConfig, argparse.Namespace]:
             f"--fuse_rounds must be >= 1, got {cfg.fed.fuse_rounds}"
         )
     try:
+        # server-optimizer plane: validate HERE, not at first round
+        # close where a supervised server would crash-loop its restart
+        # budget (the fednova+defense lesson; fedlint
+        # parse-time-validation)
+        from fedml_tpu.algorithms.fedavg import make_server_optimizer
+
+        make_server_optimizer(cfg.fed.server_optimizer,
+                              cfg.fed.server_lr,
+                              cfg.fed.server_momentum)
+        if cfg.fed.server_lr <= 0:
+            raise ValueError(
+                f"--server_lr must be > 0, got {cfg.fed.server_lr}"
+            )
+        if not (0.0 <= cfg.fed.server_momentum < 1.0):
+            raise ValueError(
+                f"--server_momentum must be in [0, 1), got "
+                f"{cfg.fed.server_momentum}"
+            )
+        if not (0.0 <= cfg.fed.gmf < 1.0):
+            raise ValueError(
+                f"--gmf must be in [0, 1), got {cfg.fed.gmf}"
+            )
         DefensePipeline.from_fed(cfg.fed)
         CompressionSpec.from_fed(cfg.fed)
         QuarantinePolicy(threshold=a.quarantine_threshold,

@@ -29,7 +29,10 @@ def make_pipeline(stage_fn, mesh, axis_name: str = "pp"):
     from jax.sharding import PartitionSpec as P
 
     p = mesh.shape[axis_name]
-    perm = [(i, (i + 1) % p) for i in range(p)]
+    # tuple, not list: `run` below closes over this and is compiled by
+    # shard_map — a mutable closure is invisible to jit's cache key
+    # (fedlint recompile-hazard)
+    perm = tuple((i, (i + 1) % p) for i in range(p))
 
     def run(stage_params, x):
         # stage_params arrives [1, ...] on each shard; drop the stage axis
