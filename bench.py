@@ -1792,6 +1792,153 @@ def mem_bench_records(cohorts=(8, 64, 256), fuses=(1, 8)):
     return records
 
 
+def bulk_mem_bench_records(cohorts=(64, 256, 1024), block=32):
+    """Bulk-mode memory rows (``--bulk-bench``; docs/PERFORMANCE.md
+    "Bulk-client execution"): ``peak_round_hbm_mb_c{C}_b{B}_bulk`` at a
+    FIXED population (the largest cohort) so the dataset argument bytes
+    are constant across the sweep and the only per-C term left is the
+    round program's own — which the block-streamed engine must hold
+    FLAT (<= 1.5x across the 16x cohort sweep at fixed B, the ROADMAP
+    item 2 acceptance) while the stacked baseline family
+    (``peak_round_hbm_mb_c{8,64,256}_k{1,8}``, unchanged above) keeps
+    pinning the O(C) law. Unlike :func:`mem_bench_records`, ``value``
+    is ALWAYS the program's own analytic ``temp + argument`` bytes
+    (marked ``"analytic": true``): the allocator's
+    ``peak_bytes_in_use`` is process-lifetime-monotone, so after the
+    stacked sweep runs in the same process every bulk row would
+    report max(stacked ceiling, bulk peak) — a flatness acceptance
+    measured that way could pass with the bulk engine regressed to
+    O(C). The live device peak rides along as the diagnostic
+    ``device_peak_mb`` field instead. ``MB peak`` is lower-is-better
+    in bench_diff and CPU records carry the PR 6 fallback mark via
+    emit()."""
+    import jax
+
+    from fedml_tpu.config import (
+        DataConfig,
+        ExperimentConfig,
+        FedConfig,
+        ModelConfig,
+        TrainConfig,
+    )
+    from fedml_tpu.algorithms.fedavg import FedAvgSim
+    from fedml_tpu.core import memscope as M
+    from fedml_tpu.core import telemetry
+    from fedml_tpu.data.loaders import load_dataset
+    from fedml_tpu.models import create_model
+
+    was_enabled = telemetry.METRICS.enabled
+    telemetry.METRICS.enabled = True
+    records = []
+    kind = jax.devices()[0].device_kind
+    population = max(cohorts)
+    try:
+        for c in cohorts:
+            cfg = ExperimentConfig(
+                data=DataConfig(dataset="synthetic_1_1",
+                                num_clients=population, batch_size=32,
+                                seed=0),
+                model=ModelConfig(name="lr", num_classes=10,
+                                  input_shape=(60,)),
+                train=TrainConfig(lr=0.1, epochs=1),
+                fed=FedConfig(num_rounds=1, clients_per_round=c,
+                              eval_every=10**9,
+                              client_block_size=block),
+                seed=0,
+            )
+            sim = FedAvgSim(create_model(cfg.model),
+                            load_dataset(cfg.data), cfg)
+            state = sim.init()
+            state, _ = sim.run_round(state)
+            jax.block_until_ready(jax.tree.leaves(state))
+            prog = M.program_record("sim_bulk", sim._program_key())
+            assert prog is not None, "bulk program accounting missing"
+            sample = M.MONITOR.sample(tag=f"bulk_mem_c{c}_b{block}")
+            analytic_mb = (
+                prog["temp_bytes"] + prog["argument_bytes"]
+            ) / 1e6
+            real_peak = (
+                sample["peak_bytes"]
+                if sample and sample["source"] == "device"
+                else None
+            )
+            records.append({
+                "metric": f"peak_round_hbm_mb_c{c}_b{block}_bulk",
+                "value": round(analytic_mb, 3),
+                "unit": "MB peak",
+                "vs_baseline": None,
+                "analytic": True,
+                "device_peak_mb": (
+                    round(real_peak / 1e6, 3) if real_peak else None
+                ),
+                "cohort": c,
+                "block_size": block,
+                "blocks": sim._n_blocks,
+                "temp_mb": round(prog["temp_bytes"] / 1e6, 3),
+                "argument_mb": round(
+                    prog["argument_bytes"] / 1e6, 3
+                ),
+                "output_mb": round(prog["output_bytes"] / 1e6, 3),
+                "compile_s": round(prog.get("compile_s", 0.0), 3),
+                "device": kind,
+            })
+            del sim, state
+    finally:
+        telemetry.METRICS.enabled = was_enabled
+    return records
+
+
+def bulk_10k_rate_record(rounds: int, block: int = 32) -> dict:
+    """``fedavg_rounds_per_sec_10kc_mnist_lr``: the first 10k-client
+    round rate from REAL block-streamed training — every one of the
+    10 000 sampled clients runs its actual local SGD inside the
+    compiled round (``core/bulk.py``), not ``simulate_open_loop``'s
+    discrete-event control-plane model (whose records say so in their
+    ``"sim"`` field). MNIST-shaped procedural data at the mnist_lr
+    family's model/batch (benchmark/README.md:12 scaled to a
+    10k-client population); fetch-corrected best-of-3 windows like
+    every rate record; the PR 6 fallback mark rides emit() on CPU."""
+    from fedml_tpu.config import (
+        DataConfig,
+        ExperimentConfig,
+        FedConfig,
+        ModelConfig,
+        TrainConfig,
+    )
+    from fedml_tpu.algorithms.fedavg import FedAvgSim
+    from fedml_tpu.data.loaders import make_fake_image_dataset
+    from fedml_tpu.models import create_model
+
+    n_clients = 10_000
+    dcfg = DataConfig(dataset="mnist", num_clients=n_clients,
+                      batch_size=10, seed=0)
+    cfg = ExperimentConfig(
+        data=dcfg,
+        model=ModelConfig(name="lr", num_classes=10,
+                          input_shape=(28, 28, 1)),
+        train=TrainConfig(lr=0.03, epochs=1),
+        fed=FedConfig(num_rounds=1000, clients_per_round=n_clients,
+                      eval_every=10**9, client_block_size=block),
+        seed=0,
+    )
+    data = make_fake_image_dataset("mnist", dcfg, n_train=60000)
+    sim = FedAvgSim(create_model(cfg.model), data, cfg)
+    rec = rate_record(
+        sim, "fedavg_rounds_per_sec_10kc_mnist_lr",
+        max(3, min(rounds, 6)), None, True,
+    )
+    rec.update({
+        "clients_trained_per_round": n_clients,
+        "block_size": block,
+        "blocks_per_round": sim._n_blocks,
+        "real_training": True,
+        "note": "block-streamed REAL local training for all 10k "
+                "sampled clients (core/bulk.py), not the open-loop "
+                "discrete-event model",
+    })
+    return rec
+
+
 # the probe replicates the platform selection bench itself uses (honor
 # JAX_PLATFORMS even though sitecustomize pins the platform via
 # jax.config — same escape hatch as experiments/run.py)
@@ -1965,6 +2112,17 @@ def main():
                          "'analytic' on the CPU fallback; the O(C) "
                          "baseline the bulk-client engine must "
                          "flatten (docs/PERFORMANCE.md)")
+    ap.add_argument("--bulk-bench", action="store_true",
+                    help="ONLY the bulk-client engine stage "
+                         "(docs/PERFORMANCE.md 'Bulk-client "
+                         "execution'): flat-memory rows "
+                         "peak_round_hbm_mb_c{64,256,1024}_b{32}_bulk "
+                         "at a FIXED population (<= 1.5x across the "
+                         "16x cohort sweep is the acceptance bar) "
+                         "plus fedavg_rounds_per_sec_10kc_mnist_lr "
+                         "from REAL block-streamed training of all "
+                         "10k sampled clients (not the open-loop "
+                         "discrete-event model)")
     ap.add_argument("--fallback-only", action="store_true",
                     help="emit ONLY the marked CPU-fallback record "
                          "(+ one small labeled CPU measurement): the "
@@ -2096,6 +2254,17 @@ def main():
     if args.mem_bench:
         for rec in staged("mem", mem_bench_records):
             emit(rec)
+        # the bulk-mode rows ride the memory stage too: the O(C)
+        # stacked baseline and the flat O(block) law belong in one
+        # artifact (docs/PERFORMANCE.md "Bulk-client execution")
+        for rec in staged("bulk_mem", bulk_mem_bench_records):
+            emit(rec)
+        return
+    if args.bulk_bench:
+        for rec in staged("bulk_mem", bulk_mem_bench_records):
+            emit(rec)
+        emit(staged("bulk_rate",
+                    lambda: bulk_10k_rate_record(args.rounds)))
         return
     if args.async_bench:
         for rec in staged("async", async_bench_records):
@@ -2254,6 +2423,18 @@ def main():
             emit(rec)
     except Exception as err:
         print(f"[bench] mem stage failed: {err}", file=sys.stderr,
+              flush=True)
+    try:
+        # bulk-client engine (docs/PERFORMANCE.md "Bulk-client
+        # execution"): flat-memory rows at fixed population + the
+        # first REAL 10k-client round rate — both tracked by
+        # bench_diff from this PR on (ROADMAP item 2 acceptance)
+        for rec in staged("bulk_mem", bulk_mem_bench_records):
+            emit(rec)
+        emit(staged("bulk_rate",
+                    lambda: bulk_10k_rate_record(args.rounds)))
+    except Exception as err:
+        print(f"[bench] bulk stage failed: {err}", file=sys.stderr,
               flush=True)
     sim, _ = build_sim(model_name="resnet56")
     emit(staged(

@@ -192,6 +192,16 @@ echo "== mem smoke: per-program HBM accounting + donation audit + /statusz memor
 # lower-is-better (docs/OBSERVABILITY.md "Memory & compilation")
 JAX_PLATFORMS=cpu python scripts/mem_smoke.py "$OUT/mem"
 
+echo "== bulk smoke: O(block) streaming round + convergence + bulk.* gauges =="
+# the bulk-client engine end-to-end on CPU: the block program's
+# argument/temp bytes stay FLAT from C=64 to C=256 at B=16 (fixed
+# population) while the stacked round's O(C) growth dwarfs it, a real
+# block-streamed run converges on the mnist_lr shape and matches the
+# stacked trajectory, the donation audit reports 0 misses on the block
+# program, and the bulk.* vocabulary is live on /metrics
+# (docs/PERFORMANCE.md "Bulk-client execution")
+JAX_PLATFORMS=cpu python scripts/bulk_smoke.py "$OUT/bulk"
+
 echo "== fuse smoke: --fuse_rounds 4 parity + one compile per (bucket, K) =="
 # a tiny sim fused at K=4 must reproduce the unfused run's final loss,
 # compile exactly one block program per (bucket, block length), log a

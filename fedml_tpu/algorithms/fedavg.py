@@ -31,6 +31,7 @@ import optax
 
 from fedml_tpu.config import ExperimentConfig, FedConfig, TrainConfig
 from fedml_tpu.core import adversary as A
+from fedml_tpu.core import bulk as BK
 from fedml_tpu.core import compress as C
 from fedml_tpu.core import elastic as E
 from fedml_tpu.core import memscope as M
@@ -193,8 +194,34 @@ def server_update(
         agg_delta = pipe.reduce(deltas, n_k, red, valid)
 
     agg_delta = pipe.postprocess(agg_delta, jax.random.fold_in(rkey, 1))
+    new_params, new_opt_state, new_momentum = _server_delta_step(
+        fed, state, agg_delta
+    )
 
-    # global momentum buffer (FedNova gmf; reference fednova.py gmf option)
+    # non-param collections (batch_stats): plain weighted mean, like the
+    # reference's full-state_dict averaging (FedAVGAggregator.py:73-81)
+    other = {
+        k: red.wmean(v, n_k)
+        for k, v in stacked_vars.items()
+        if k != "params"
+    }
+    return ServerState(
+        variables={**other, "params": new_params},
+        opt_state=new_opt_state,
+        momentum=new_momentum,
+        round=state.round + 1,
+    )
+
+
+def _server_delta_step(fed: FedConfig, state: ServerState,
+                       agg_delta: Pytree):
+    """The post-reduce server tail — global momentum buffer (FedNova
+    gmf) + server optimizer step — shared verbatim by the stacked
+    (:func:`server_update`) and streaming
+    (:func:`server_update_from_partials`) aggregation paths, so the two
+    cannot drift past the reduce itself. Returns ``(new_params,
+    new_opt_state, new_momentum)``."""
+    global_params = state.variables["params"]
     if fed.gmf > 0:
         new_momentum = T.tree_add(
             T.tree_scale(state.momentum, fed.gmf), agg_delta
@@ -211,13 +238,104 @@ def server_update(
         pseudo_grad, state.opt_state, global_params
     )
     new_params = optax.apply_updates(global_params, updates)
+    return new_params, new_opt_state, new_momentum
 
-    # non-param collections (batch_stats): plain weighted mean, like the
-    # reference's full-state_dict averaging (FedAVGAggregator.py:73-81)
+
+def fold_block_partials(
+    fed: FedConfig,
+    train: TrainConfig,
+    steps_per_epoch: int,
+    batch_size: int,
+    state: ServerState,
+    stacked_vars: Pytree,
+    n_k: jax.Array,
+    msums: dict,
+    rejected: jax.Array,
+) -> BK.RoundPartials:
+    """Reduce ONE block of (injected/healed/screened) stacked local
+    results to its O(model) :class:`~fedml_tpu.core.bulk.RoundPartials`
+    — the streaming half of :func:`server_update`. Mirrors the stacked
+    reduce head exactly: delta against the global params, defense
+    preprocess (per-row clip), FedNova's per-row tau normalization.
+    Weighted sums ride ``T.tree_weighted_sum`` (the same f32
+    accumulator ``tree_weighted_mean`` uses), so bulk-vs-stacked parity
+    is the reduce-reassociation ulp band and nothing more (pinned in
+    ``tests/test_bulk.py``)."""
+    pipe = robust.DefensePipeline.from_fed(fed)
+    global_params = state.variables["params"]
+    deltas = jax.tree.map(
+        lambda s, g: s - g[None], stacked_vars["params"], global_params
+    )
+    deltas = pipe.preprocess(deltas)
+    nf = n_k.astype(jnp.float32)
+    if fed.algorithm == "fednova":
+        tau = (
+            jnp.ceil(n_k / batch_size).clip(1, steps_per_epoch)
+            * train.epochs
+        )
+        deltas = jax.tree.map(
+            lambda v: v / tau.reshape((-1,) + (1,) * (v.ndim - 1)),
+            deltas,
+        )
+        tau_wsum = jnp.sum(nf * tau)
+    else:
+        tau_wsum = jnp.zeros((), jnp.float32)
+
+    return BK.RoundPartials(
+        delta_wsum=T.tree_weighted_sum(deltas, nf),
+        other_wsum={
+            k: T.tree_weighted_sum(v, nf)
+            for k, v in stacked_vars.items()
+            if k != "params"
+        },
+        n_sum=jnp.sum(nf),
+        tau_wsum=tau_wsum,
+        msums=jax.tree.map(jnp.sum, msums),
+        rejected=rejected,
+    )
+
+
+def server_update_from_partials(
+    fed: FedConfig,
+    state: ServerState,
+    partials: BK.RoundPartials,
+    rkey: jax.Array,
+) -> ServerState:
+    """One server step from GLOBALLY-reduced streaming partials — the
+    bulk twin of :func:`server_update`, sharing its exact tail
+    (:func:`_server_delta_step`). ``partials`` must already be summed
+    over every block (and every shard: the mesh runtime psums the
+    O(model) partials before calling this, replacing the stacked
+    wmean/gather collectives). Only ``mean``/FedNova reduce rules reach
+    here — :func:`fedml_tpu.core.bulk.check_bulk_compat` rejected
+    everything else at construction; the assert is the traced-program
+    backstop."""
+    pipe = robust.DefensePipeline.from_fed(fed)
+    assert pipe.method in BK.BULK_REDUCE_RULES, pipe.method
+    global_params = state.variables["params"]
+    # the same max(Σw, 1e-12) guard tree_weighted_mean applies, so the
+    # degenerate all-zero-weight round degrades identically
+    denom = jnp.maximum(partials.n_sum, 1e-12)
+    agg_delta = jax.tree.map(
+        lambda s, g: (s / denom).astype(g.dtype),
+        partials.delta_wsum, global_params,
+    )
+    if fed.algorithm == "fednova":
+        # tau_eff = Σ n·tau / Σ n, exactly the stacked formula with
+        # both sums pre-reduced
+        agg_delta = T.tree_scale(
+            agg_delta, partials.tau_wsum / partials.n_sum
+        )
+    agg_delta = pipe.postprocess(agg_delta, jax.random.fold_in(rkey, 1))
+    new_params, new_opt_state, new_momentum = _server_delta_step(
+        fed, state, agg_delta
+    )
     other = {
-        k: red.wmean(v, n_k)
-        for k, v in stacked_vars.items()
-        if k != "params"
+        k: jax.tree.map(
+            lambda s, g: (s / denom).astype(g.dtype),
+            v, state.variables[k],
+        )
+        for k, v in partials.other_wsum.items()
     }
     return ServerState(
         variables={**other, "params": new_params},
@@ -319,6 +437,28 @@ class FedAvgSim:
             if self._elastic else cohort
         )
         self._n_active = cohort
+        # -- bulk-client streaming (core/bulk.py, docs/PERFORMANCE.md
+        # "Bulk-client execution"): with cfg.fed.client_block_size = B
+        # the round streams the cohort through the device in blocks of
+        # B vmapped local updates, each folded into an O(model)
+        # partial-sum scan carry — peak memory O(B + model), not O(C).
+        # Incompatible configs (selection defenses, compression, the
+        # gauss adversary) are rejected HERE, loudly. Off by default:
+        # the stacked round stays byte-identical.
+        self._bulk = BK.BulkSpec.from_fed(cfg.fed)
+        if self._bulk.enabled():
+            BK.check_bulk_compat(cfg.fed, cfg.adversary)
+            self._block_size = self._bulk.block_size
+            # elastic buckets apply to the BLOCK COUNT: the compiled
+            # scan length is the power-of-two bucket of ceil(C/B)
+            # blocks, so cohort churn within it is a cache hit
+            self._n_blocks = BK.plan_blocks(
+                cohort, self._block_size, self._elastic
+            )
+            self._slots = self._n_blocks * self._block_size
+            # the live cohort can grow into the headroom blocks, but
+            # never past the population (sampling is w/o replacement)
+            self._max_live = min(self._slots, cfg.data.num_clients)
         self._cohort_groups = _resolve_cohort_groups(
             cfg.train.cohort_groups, cohort
         )
@@ -332,6 +472,9 @@ class FedAvgSim:
             # the cohort-grouped network bakes the cohort size into its
             # widened layer shapes — bucketing covers the vmapped path
             and not self._elastic
+            # the bulk engine streams the VMAPPED update per block (the
+            # widened cohort network would bake C back into one program)
+            and not self._bulk.enabled()
             else None
         )
         self.evaluator = build_evaluator(model, self.task)
@@ -353,8 +496,12 @@ class FedAvgSim:
         # memory_analysis recorded (mem.program.*), and the donated
         # state/residual audited is_deleted after the first execution.
         # ProgramSite exposes _cache_size, so the elastic paths'
-        # mirror_jit_cache accounting is unchanged.
-        self._round_fn = M.ProgramSite(self._round, family="sim_round",
+        # mirror_jit_cache accounting is unchanged. Bulk rounds get
+        # their own program family (sim_bulk.<blocks>.<B>) so the
+        # mem.program.* accounting and the donation audit name the
+        # block program distinctly from the stacked one.
+        family = "sim_bulk" if self._bulk.enabled() else "sim_round"
+        self._round_fn = M.ProgramSite(self._round, family=family,
                                        donate_argnums=donate)
         # -- fused multi-round execution (core/fuse.py, docs/
         # PERFORMANCE.md "Round fusion"): with fuse_rounds K > 1 ONE
@@ -376,8 +523,14 @@ class FedAvgSim:
         # the SAME fused-block scan wraps either body
         self._round_impl = self._round
         self._block_fn = (
-            M.ProgramSite(self._fused_block, family="sim_block",
-                          static_argnums=(4,), donate_argnums=donate)
+            M.ProgramSite(
+                self._fused_block,
+                family=(
+                    "sim_bulk_block" if self._bulk.enabled()
+                    else "sim_block"
+                ),
+                static_argnums=(4,), donate_argnums=donate,
+            )
             if self._fuse > 1 else None
         )
         # process-global headroom threshold for the memory monitor
@@ -423,6 +576,18 @@ class FedAvgSim:
                 "True) — the static round program bakes the cohort "
                 "size into its shapes"
             )
+        if self._bulk.enabled():
+            # bulk mode buckets the BLOCK COUNT: any cohort within the
+            # compiled block grid reuses the one scan program
+            if not (1 <= n <= self._max_live):
+                raise ValueError(
+                    f"cohort size {n} does not fit the compiled "
+                    f"{self._n_blocks}x{self._block_size} block grid "
+                    f"(live cohort must stay in [1, {self._max_live}]; "
+                    "grow needs a new simulator)"
+                )
+            self._n_active = n
+            return
         if not (1 <= n <= self._bucket):
             raise ValueError(
                 f"cohort size {n} does not fit the compiled bucket "
@@ -445,6 +610,28 @@ class FedAvgSim:
         return jax.random.choice(
             key, num_clients, shape=(self._bucket,), replace=False
         ).astype(jnp.int32)
+
+    def _sample_slot_ids(self, key, num_clients: int) -> jax.Array:
+        """Elastic-bulk sampling: ``[slots]`` client ids whose live
+        PREFIX is the round's cohort (the bulk twin of
+        :meth:`_sample_bucket` — a permutation when the grid covers the
+        population, so the live prefix never pins the same clients).
+        Slots beyond the population are dead by construction
+        (``_max_live``) and carry an arbitrary id the live mask
+        hides."""
+        draw = min(self._slots, num_clients)
+        if draw >= num_clients:
+            ids = jax.random.permutation(key, num_clients).astype(
+                jnp.int32
+            )
+        else:
+            ids = jax.random.choice(
+                key, num_clients, shape=(draw,), replace=False
+            ).astype(jnp.int32)
+        pad = self._slots - draw
+        if pad:
+            ids = jnp.concatenate([ids, jnp.zeros((pad,), jnp.int32)])
+        return ids
 
     # -- one round ---------------------------------------------------------
     def _locals(self, state: ServerState, arrays: FederatedArrays,
@@ -564,8 +751,90 @@ class FedAvgSim:
             )
         return stacked_vars, new_residual
 
+    def _bulk_round(self, state: ServerState, arrays: FederatedArrays,
+                    n_active=None):
+        """The block-streamed round body (core/bulk.py,
+        docs/PERFORMANCE.md "Bulk-client execution"): sample the
+        cohort, chunk it into ``block_size`` slots, run each block
+        through the SAME vmapped local update / adversary injection /
+        padding-heal / non-finite screen the stacked round applies,
+        and fold each block's :func:`fold_block_partials` into the
+        O(model) scan carry. Peak memory is O(block + model) — no
+        ``[C, ...]`` stacked operand ever materializes. The final
+        server step is :func:`server_update_from_partials`, which
+        shares :func:`server_update`'s exact post-reduce tail."""
+        cfg = self.cfg.fed
+        rkey = R.round_key(self.root_key, state.round)
+        skey = jax.random.fold_in(rkey, 0)
+        if n_active is not None:
+            # elastic: full-grid draw, live prefix = the traced cohort
+            ids = self._sample_slot_ids(skey, arrays.num_clients)
+            live = E.active_mask(self._slots, n_active)
+        else:
+            # static: the SAME draw the stacked round makes (parity),
+            # tail slots padded with a masked dummy id
+            cohort = self.sampler(
+                skey, arrays.num_clients, cfg.clients_per_round
+            )
+            pad = self._slots - cohort.shape[0]
+            ids = (
+                jnp.concatenate([cohort, jnp.zeros((pad,), jnp.int32)])
+                if pad else cohort
+            )
+            live = (
+                E.active_mask(self._slots, cohort.shape[0])
+                if pad else None
+            )
+
+        def fold_block(block_ids, block_live):
+            ckeys = jax.vmap(lambda c: R.client_key(rkey, c))(block_ids)
+            idx_rows = arrays.idx[block_ids]
+            mask_rows = arrays.mask[block_ids]
+            stacked_vars, n_k, msums = jax.vmap(
+                self.local_update, in_axes=(None, 0, 0, None, None, 0)
+            )(state.variables, idx_rows, mask_rows, arrays.x, arrays.y,
+              ckeys)
+            if self.cfg.adversary.enabled():
+                stacked_vars = self._inject_adversaries(
+                    state, arrays, stacked_vars, block_ids
+                )
+            if block_live is not None:
+                # padded slots (partial final block / elastic headroom)
+                # healed exactly like a bucketed stacked round's
+                stacked_vars, n_k, msums = E.mask_padded(
+                    stacked_vars, n_k, msums, state.variables,
+                    block_live,
+                )
+            stacked_vars, n_k, rejected = self._screen_nonfinite(
+                state, stacked_vars, n_k
+            )
+            return fold_block_partials(
+                cfg, self.cfg.train, self.steps_per_epoch,
+                self.batch_size, state, stacked_vars, n_k, msums,
+                rejected,
+            )
+
+        partials = BK.stream_blocks(
+            fold_block, ids, live, self._block_size
+        )
+        new_state = server_update_from_partials(
+            cfg, state, partials, rkey
+        )
+        fin = finalize_sums(partials.msums)
+        train_metrics = {
+            "train_loss": fin["loss"],
+            "train_acc": fin["acc"],
+            "nonfinite_rejected": partials.rejected,
+        }
+        return new_state, train_metrics
+
     def _round(self, state: ServerState, arrays: FederatedArrays,
                n_active=None, residual=None):
+        if self._bulk.enabled():
+            # compression (and so the residual operand) is rejected at
+            # construction in bulk mode — the python-level dispatch
+            # keeps the stacked trace below byte-identical when off
+            return self._bulk_round(state, arrays, n_active)
         cfg = self.cfg.fed
         stacked_vars, n_k, msums, rkey, cohort = self._locals(
             state, arrays, n_active
@@ -693,7 +962,15 @@ class FedAvgSim:
             jnp.asarray(self._n_active, jnp.int32)
             if self._elastic else None
         )
-        key = (self._bucket, length)
+        if self._bulk.enabled():
+            # nested scans: the outer fused-round scan wraps the inner
+            # block scan (the bulk round IS _round_impl's body here);
+            # the fused block counts its K rounds so bulk.rounds stays
+            # per-round like every fused metric
+            self._note_bulk_dispatch(rounds=length)
+            key = self._program_key() + (length,)
+        else:
+            key = (self._bucket, length)
 
         def call():
             return self._block_fn(
@@ -710,8 +987,30 @@ class FedAvgSim:
             return state, m
         return out
 
+    def _program_key(self) -> tuple:
+        """Executable identity of the bulk round program: the compiled
+        block grid. (Only meaningful with the bulk engine on; the
+        stacked paths key by bucket as they always have.)"""
+        return (self._n_blocks, self._block_size)
+
+    def _note_bulk_dispatch(self, rounds: int = 1) -> None:
+        BK.note_round(
+            self._block_size, self._n_blocks,
+            self._slots - self._n_active, rounds=rounds,
+        )
+
     # -- public API --------------------------------------------------------
     def run_round(self, state: ServerState):
+        if self._bulk.enabled():
+            self._note_bulk_dispatch()
+            key = self._program_key()
+            if not self._elastic:
+                return self._round_fn(key, state, self.arrays)
+            n = jnp.asarray(self._n_active, jnp.int32)
+            return E.mirror_jit_cache(
+                self._round_fn,
+                lambda: self._round_fn(key, state, self.arrays, n),
+            )
         compressed = self._cspec.enabled()
         if compressed and self._ef_residual is None:
             self._ef_residual = C.zero_residual(

@@ -173,6 +173,19 @@ class FedConfig:
     # the telemetry plane (on when metrics are on, one attribute
     # check otherwise).
     mem_headroom_warn: float = 0.9
+    # device-resident bulk-client execution (core/bulk.py,
+    # docs/PERFORMANCE.md "Bulk-client execution"): stream the sampled
+    # cohort through the device in fixed-size blocks of B clients —
+    # each block runs the vmapped local update and is immediately
+    # folded into an O(model) partial-sum lax.scan carry, so peak
+    # round memory is O(B + model) instead of O(cohort). mean/FedNova
+    # reduce rules only (selection defenses need the full [C, D] stack
+    # and are rejected at construction); composes with elastic_buckets
+    # (buckets apply to the block COUNT) and fuse_rounds (nested
+    # scans); incompatible with compress (the error-feedback residual
+    # is itself an O(C) buffer). 0 (default) keeps the stacked
+    # [C, ...] round byte-identical.
+    client_block_size: int = 0
     # fused multi-round execution (core/fuse.py, docs/PERFORMANCE.md
     # "Round fusion"): run K complete rounds as ONE compiled program —
     # a lax.scan over the round body with the server state (and the

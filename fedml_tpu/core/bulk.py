@@ -1,0 +1,230 @@
+"""Device-resident bulk-client execution: scan-chunked streaming cohorts.
+
+Every simulated client in the stacked round is a row of a ``[C, ...]``
+operand inside one compiled program, so HBM grows linearly with cohort
+size — the O(C) law ``bench.py --mem-bench`` pinned
+(``peak_round_hbm_mb_c{8,64,256}``: 0.62 → 4.5 → 18.0 MB) and the
+reason the 10k-client acceptance previously ran in a discrete-event
+model instead of real training. This module is the FedJAX
+``for_each_client`` idiom (PAPERS.md), ROADMAP item 2: stream the
+sampled cohort through the device in fixed-size **blocks** of ``B``
+clients. Each block runs the existing vmapped local update and is
+immediately reduced to an O(model) partial —
+
+    delta_wsum += Σ_r n_r · (clipped, tau-normalized) delta_r
+    n_sum      += Σ_r n_r
+    metric sums, non-param collections alike
+
+— the same ``[weighted-delta-sum, mass, n, metric-sums]`` vocabulary
+the :class:`~fedml_tpu.core.async_agg.AsyncBuffer` fold and the tier
+machinery's ``[sum, n, count]`` partials already speak. The partials
+fold through a ``lax.scan`` carry, so peak round memory is
+**O(B + model)**, independent of C; only the final server step
+(:func:`fedml_tpu.algorithms.fedavg.server_update_from_partials`)
+touches model-sized state.
+
+Contract honesty, stated like :mod:`fedml_tpu.core.elastic` states its
+padding tiers:
+
+- **Exact rules**: clip (per-row) + ``mean`` reduce and FedNova
+  tau-normalized averaging decompose into partial sums exactly — bulk
+  agrees with the stacked round within the reduce-reassociation ulp
+  band (blockwise sums then a combine, vs one reduction over C; the
+  same equality class as bucket padding / sharded psum, pinned in
+  ``tests/test_bulk.py``).
+- **Rejected rules**: selection/gather defenses (``median`` /
+  ``trimmed_mean`` / ``krum`` / ``multikrum`` / ``fltrust``) score the
+  full ``[C, D]`` stacked-delta matrix, which the streaming reduce
+  never materializes. They are rejected LOUDLY at construction
+  (:func:`check_bulk_compat`), never silently approximated.
+- **Rejected composition**: wire compression's error-feedback residual
+  is a dense ``[cohort, ...]`` carry — itself the O(C) buffer the
+  block scan exists to eliminate — so ``compress + bulk`` is rejected
+  at construction (a sharded/host-resident residual bank is the future
+  fix; rejection is the honest present). The ``gauss`` adversary mode
+  draws its noise over the full stacked shape and would repeat the
+  draw per block; every other adversary mode is per-row and composes.
+
+Elasticity applies to the block COUNT: the scan length is the
+power-of-two bucket of ``ceil(C / B)`` blocks, the live cohort count
+rides as a traced operand, and a partial final block is healed by the
+existing :func:`fedml_tpu.core.elastic.mask_padded` — cohort churn
+within the block bucket costs a compile-cache hit, not a recompile.
+
+Telemetry (docs/OBSERVABILITY.md): ``bulk.block_size``,
+``bulk.blocks_per_round``, ``bulk.padded_slots`` gauges and the
+``bulk.rounds`` counter, written host-side at dispatch (never inside
+the compiled program).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.core import telemetry
+from fedml_tpu.core.elastic import bucket_for
+
+Pytree = Any
+
+#: reduce rules whose aggregate decomposes into streaming partial sums
+#: (fednova is an ALGORITHM, not a robust_method, and composes because
+#: its tau normalization is per-row before the weighted sum)
+BULK_REDUCE_RULES = ("mean",)
+
+
+@dataclasses.dataclass(frozen=True)
+class BulkSpec:
+    """Frozen description of the block-streaming mode (rides
+    ``FedConfig.client_block_size``; 0 = off, the stacked ``[C, ...]``
+    round stays byte-identical)."""
+
+    block_size: int = 0
+
+    def __post_init__(self):
+        if self.block_size < 0:
+            raise ValueError(
+                f"client_block_size must be >= 0 (0 = stacked mode), "
+                f"got {self.block_size}"
+            )
+
+    @staticmethod
+    def from_fed(fed) -> "BulkSpec":
+        return BulkSpec(
+            block_size=getattr(fed, "client_block_size", 0) or 0
+        )
+
+    def enabled(self) -> bool:
+        return self.block_size > 0
+
+
+def check_bulk_compat(fed, adversary=None) -> None:
+    """Reject configurations the streaming partial-sum reduce cannot
+    express EXACTLY — raised at construction (and at run.py parse
+    time), never silently approximated mid-run."""
+    method = getattr(fed, "robust_method", "mean") or "mean"
+    if method not in BULK_REDUCE_RULES:
+        raise ValueError(
+            f"robust_method={method!r} is incompatible with bulk "
+            "(client_block_size) execution: selection/gather defenses "
+            "(median/trimmed_mean/krum/multikrum/fltrust) score the "
+            "full [C, D] stacked-delta matrix, which the O(block) "
+            "streaming reduce never materializes. Run the defended "
+            "cohort on the stacked path (client_block_size=0); "
+            "robust_norm_clip and robust_noise_stddev DO compose "
+            "(per-row clip, aggregate noise)."
+        )
+    if getattr(fed, "compress", "none") not in ("none", "", None):
+        raise ValueError(
+            "compress is incompatible with bulk (client_block_size) "
+            "execution: the error-feedback residual is a dense "
+            "[cohort, ...] carry — exactly the O(C) buffer the block "
+            "scan exists to eliminate (core/bulk.py). Use the stacked "
+            "path (client_block_size=0) for compressed experiments."
+        )
+    if adversary is not None and adversary.enabled() \
+            and adversary.mode == "gauss":
+        raise ValueError(
+            "adversary mode 'gauss' is incompatible with bulk "
+            "(client_block_size) execution: its noise is drawn over "
+            "the full stacked [C, ...] shape, so a per-block "
+            "application would repeat the same draw every block. Use "
+            "the stacked path, or a per-row mode (sign_flip/"
+            "scale_boost/zero/constant/collude — all compose with "
+            "bulk)."
+        )
+
+
+def plan_blocks(cohort: int, block_size: int, elastic: bool) -> int:
+    """Number of scan blocks for a ``cohort`` streamed in blocks of
+    ``block_size``. Under ``elastic`` the count is bucketed to the next
+    power of two — the compiled scan length depends only on the bucket,
+    so cohort churn within it is a compile-cache hit (headroom blocks
+    are fully masked)."""
+    if cohort < 1:
+        raise ValueError(f"cohort must be >= 1, got {cohort}")
+    if block_size < 1:
+        raise ValueError(f"block_size must be >= 1, got {block_size}")
+    nb = -(-cohort // block_size)
+    return bucket_for(nb) if elastic else nb
+
+
+class RoundPartials(NamedTuple):
+    """The O(model) streaming-aggregation vocabulary: what one block of
+    local updates reduces to, and what the whole round's scan carry
+    accumulates — the same ``[weighted-delta-sum, mass, n,
+    metric-sums]`` shape the :class:`~fedml_tpu.core.async_agg.
+    AsyncBuffer` fold speaks (its ``sum``/``mass``/``count``), so the
+    bulk round, the async server, and the tier partial uplinks all
+    aggregate in one algebra. Built per block by
+    :func:`fedml_tpu.algorithms.fedavg.fold_block_partials` and
+    finalized by ``server_update_from_partials``."""
+
+    delta_wsum: Pytree  # Σ n_r · (clipped[, /tau_r]) delta_r, f32 leaves
+    other_wsum: dict  # Σ n_r · non-param collections (batch_stats)
+    n_sum: jax.Array  # Σ n_r (the mass)
+    tau_wsum: jax.Array  # Σ n_r · tau_r (fednova; 0 otherwise)
+    msums: dict  # additive metric sums (scalar leaves)
+    rejected: jax.Array  # non-finite rows screened (scalar f32)
+
+
+def stream_blocks(
+    fold_block: Callable[..., Pytree],
+    ids: jax.Array,
+    live: jax.Array | None,
+    block_size: int,
+) -> Pytree:
+    """Fold ``ids`` (``[S]`` client ids, ``S`` a multiple of
+    ``block_size``) through ``fold_block(block_ids[, block_live])`` in
+    fixed-size blocks, summing the returned partials through a
+    ``lax.scan`` carry — the O(B + model) round body. ``live`` (``[S]``
+    bool or None = all live) rides the scan as a per-block operand so a
+    traced live count never retraces the program. A single-block cohort
+    skips the scan entirely (no loop-carry layout copies for the
+    B >= C case)."""
+    n_slots = ids.shape[0]
+    if n_slots % block_size != 0:
+        raise ValueError(
+            f"slot count {n_slots} is not a multiple of block size "
+            f"{block_size}"
+        )
+    nb = n_slots // block_size
+    ids_b = ids.reshape(nb, block_size)
+    if live is None:
+        fold = lambda bids, _unused: fold_block(bids, None)
+        xs = (ids_b, jnp.zeros((nb,), jnp.int32))
+    else:
+        fold = fold_block
+        xs = (ids_b, live.reshape(nb, block_size))
+    if nb == 1:
+        return fold(*jax.tree.map(lambda a: a[0], xs))
+    shapes = jax.eval_shape(fold, *jax.tree.map(lambda a: a[0], xs))
+    zero = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+
+    def body(carry, x):
+        p = fold(*x)
+        return jax.tree.map(jnp.add, carry, p), None
+
+    out, _ = jax.lax.scan(body, zero, xs)
+    return out
+
+
+def note_round(block_size: int, n_blocks: int, padded_slots: int,
+               rounds: int = 1) -> None:
+    """Host-side per-dispatch telemetry for the bulk engine
+    (docs/OBSERVABILITY.md vocabulary) — called by the drivers'
+    ``run_round``/``run_block``, never from inside a compiled
+    program. ``rounds`` is the round count this dispatch executes (a
+    fused block passes its K, so ``bulk.rounds`` stays per-ROUND like
+    every fused metric — the perf.* wall/K discipline). One attribute
+    check when the metrics plane is off."""
+    m = telemetry.METRICS
+    if not m.enabled:
+        return
+    m.gauge("bulk.block_size", float(block_size))
+    m.gauge("bulk.blocks_per_round", float(n_blocks))
+    m.gauge("bulk.padded_slots", float(padded_slots))
+    m.inc("bulk.rounds", float(rounds))

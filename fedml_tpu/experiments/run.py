@@ -27,6 +27,13 @@ if os.environ.get("JAX_PLATFORMS"):
 from fedml_tpu.config import ExperimentConfig
 from fedml_tpu.experiments.harness import ALGORITHMS, Experiment
 
+# the FedAvg-family simulators whose compiled round wires in adversary
+# injection, the wire codec, round fusion, and bulk streaming — every
+# other sim ignores those knobs (main() warns per flag), so their
+# compatibility matrices must neither be enforced nor reported there
+_ADVERSARY_SIMS = {"fedavg", "fedopt", "fedprox", "fednova",
+                   "fedavg_robust", "fedavg_multiclient", "fedseg"}
+
 
 def parse_args(argv=None) -> tuple[ExperimentConfig, argparse.Namespace]:
     p = argparse.ArgumentParser(
@@ -265,6 +272,23 @@ def parse_args(argv=None) -> tuple[ExperimentConfig, argparse.Namespace]:
                         "checkpoint rounds force a block boundary. 1 "
                         "(default) keeps the per-round loop byte-"
                         "identical. FedAvg-family sims only")
+    # -- bulk-client streaming (core/bulk.py; docs/PERFORMANCE.md
+    # "Bulk-client execution") ---------------------------------------------
+    p.add_argument("--client_block_size", type=int, default=None,
+                   help="simulator: stream the sampled cohort through "
+                        "the device in fixed-size blocks of B clients "
+                        "(the device-resident bulk-client engine): "
+                        "each block runs the vmapped local update and "
+                        "is folded into an O(model) partial-sum scan "
+                        "carry, so round memory is O(B + model) "
+                        "instead of O(cohort) — the 10k-client-real-"
+                        "training path. mean/FedNova reduce rules "
+                        "only (selection defenses need the full "
+                        "stacked cohort and are rejected here at "
+                        "parse time); composes with --elastic (block-"
+                        "count buckets) and --fuse_rounds (nested "
+                        "scans); incompatible with --compress. "
+                        "0/unset = the stacked [C, ...] round")
     # -- performance observability (docs/OBSERVABILITY.md) -----------------
     p.add_argument("--profile_rounds", type=int, default=None,
                    help="capture a jax.profiler window around each of "
@@ -482,6 +506,7 @@ def parse_args(argv=None) -> tuple[ExperimentConfig, argparse.Namespace]:
             shard_aggregation=True if a.shard_aggregation else None,
             profile_rounds=a.profile_rounds,
             mem_headroom_warn=a.mem_headroom_warn,
+            client_block_size=a.client_block_size,
             fuse_rounds=a.fuse_rounds,
             slos=tuple(a.slo) if a.slo else None,
         ),
@@ -545,6 +570,30 @@ def parse_args(argv=None) -> tuple[ExperimentConfig, argparse.Namespace]:
                          evict_after=a.quarantine_evict_after)
         check_fednova_compat(cfg.fed.algorithm, cfg.fed.robust_method)
         AsyncConfig.from_fed(cfg.fed)
+        # bulk-client streaming: the whole compatibility matrix
+        # (selection defenses, compress, the gauss adversary) fails at
+        # parse time, not at simulator construction (fedlint
+        # parse-time-validation discipline). Only for processes that
+        # will actually RUN a simulator: under --role/--supervise the
+        # flag is inert (warned below) and a shared config combining
+        # it with deploy-side compression must not hard-fail a rank
+        # the block size cannot affect.
+        from fedml_tpu.core.bulk import BulkSpec, check_bulk_compat
+
+        bulk = BulkSpec.from_fed(cfg.fed)
+        if bulk.enabled() and a.role is None and not a.supervise \
+                and cfg.fed.algorithm in _ADVERSARY_SIMS:
+            check_bulk_compat(cfg.fed, cfg.adversary)
+            if bulk.block_size >= cfg.fed.clients_per_round:
+                print(
+                    f"warning: --client_block_size "
+                    f"{bulk.block_size} >= clients_per_round "
+                    f"{cfg.fed.clients_per_round}: the whole cohort "
+                    "fits one block — the stacked round "
+                    "(client_block_size=0) compiles the same work "
+                    "without the streaming wrapper and wins",
+                    file=sys.stderr,
+                )
         if cfg.fed.slos:
             from fedml_tpu.core.slo import parse_specs
 
@@ -689,6 +738,15 @@ def _deploy_config(a) -> "DeployConfig":
         print(
             "warning: --repetitions is a simulator flag and is ignored "
             "under --role (each deployment process runs exactly one rank)",
+            file=sys.stderr,
+        )
+    if a.client_block_size:
+        # deploy clients are one process each — there is no stacked
+        # cohort on a rank to stream in blocks
+        print(
+            "warning: --client_block_size covers the compiled "
+            "simulators (FedAvgSim/ShardedFedAvg) and is inert under "
+            "--role (docs/PERFORMANCE.md 'Bulk-client execution')",
             file=sys.stderr,
         )
     if a.recovery_extensions and not a.round_deadline:
@@ -905,8 +963,8 @@ def main(argv=None) -> int:
     # adversary injection is wired into the FedAvgSim round program;
     # other sims (mpc/secure-agg, GAN family, splitnn, ...) aggregate
     # elsewhere and would silently run a vacuous Byzantine experiment
-    _ADVERSARY_SIMS = {"fedavg", "fedopt", "fedprox", "fednova",
-                       "fedavg_robust", "fedavg_multiclient", "fedseg"}
+    # (_ADVERSARY_SIMS is module-level: parse_args gates the bulk
+    # compatibility matrix on the same family)
     if (cfg.adversary.enabled()
             and cfg.fed.algorithm not in _ADVERSARY_SIMS):
         print(
@@ -926,6 +984,17 @@ def main(argv=None) -> int:
             f"{cfg.fed.algorithm!r} simulator (round fusion covers "
             "the FedAvg-family compiled round: "
             f"{sorted(_ADVERSARY_SIMS)}); this run executes per-round",
+            file=sys.stderr,
+        )
+    if (cfg.fed.client_block_size
+            and cfg.fed.algorithm not in _ADVERSARY_SIMS):
+        # same honesty rule as fuse_rounds: the block scan wraps the
+        # FedAvg-family round body only
+        print(
+            f"warning: --client_block_size is ignored by the "
+            f"{cfg.fed.algorithm!r} simulator (bulk streaming covers "
+            "the FedAvg-family compiled round: "
+            f"{sorted(_ADVERSARY_SIMS)}); this run executes stacked",
             file=sys.stderr,
         )
     if (cfg.fed.compress != "none"

@@ -39,6 +39,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from fedml_tpu.core.compat import shard_map
 
 from fedml_tpu.config import ExperimentConfig
+from fedml_tpu.core import bulk as BK
 from fedml_tpu.core import elastic as E
 from fedml_tpu.core import memscope as M
 from fedml_tpu.core import random as R
@@ -52,8 +53,10 @@ from fedml_tpu.algorithms.base import (
 from fedml_tpu.algorithms.fedavg import (
     FedAvgSim,
     ServerState,
+    fold_block_partials,
     psum_reducer,
     server_update,
+    server_update_from_partials,
 )
 from fedml_tpu.models.base import FedModel
 
@@ -160,13 +163,37 @@ class ShardedFedAvg(FedAvgSim):
             # the widened cohort network bakes the per-shard cohort
             # into its shapes — elastic bucketing uses the vmapped path
             and not self._elastic
+            # the bulk engine streams the vmapped update per block
+            and not self._bulk.enabled()
             else None
         )
+        # bulk-client streaming over the mesh (core/bulk.py): each
+        # shard streams its OWN sub-cohort through blocks of B vmapped
+        # local updates and psums only the O(model) partial sums at the
+        # end — the stacked wmean/gather collectives never see a
+        # [C, ...] operand. Block-count bucketing is per shard.
+        if self._bulk.enabled():
+            self._shard_blocks = BK.plan_blocks(
+                self.cohort_per_shard, self._block_size, self._elastic
+            )
+            self._shard_slots = self._shard_blocks * self._block_size
+            self._shard_max_live = min(
+                self._shard_slots,
+                data.num_clients // self.n_client_shards,
+            )
+            # the whole-sim grid the telemetry gauges report
+            self._n_blocks = self._shard_blocks * self.n_client_shards
+            self._slots = self._shard_slots * self.n_client_shards
+            self._max_live = self._shard_max_live * self.n_client_shards
         # instrumented AOT site like the single-device round
         # (core/memscope.py): compile wall + memory_analysis recorded
         # per program, the donated state audited on first execution
         self._round_fn = M.ProgramSite(
-            self._sharded_round, family="sharded_round",
+            self._sharded_round,
+            family=(
+                "sharded_bulk" if self._bulk.enabled()
+                else "sharded_round"
+            ),
             donate_argnums=(0,),
         )
         # round fusion (docs/PERFORMANCE.md "Round fusion"): the
@@ -193,6 +220,16 @@ class ShardedFedAvg(FedAvgSim):
                 f"{self.n_client_shards}-way clients mesh axis"
             )
         per = n // self.n_client_shards
+        if self._bulk.enabled():
+            if not (1 <= per <= self._shard_max_live):
+                raise ValueError(
+                    f"per-shard cohort {per} does not fit the compiled "
+                    f"{self._shard_blocks}x{self._block_size} per-shard "
+                    f"block grid (live per-shard cohort must stay in "
+                    f"[1, {self._shard_max_live}])"
+                )
+            self._n_active = n
+            return
         if not (1 <= per <= self.bucket_per_shard):
             raise ValueError(
                 f"per-shard cohort {per} does not fit the compiled "
@@ -233,6 +270,10 @@ class ShardedFedAvg(FedAvgSim):
             idx, mask = idx[0], mask[0]
             n_act = maybe_n[0] if maybe_n else None
             shard = jax.lax.axis_index(self.client_axis)
+            if self._bulk.enabled():
+                return self._bulk_shard_body(
+                    state, x, y, idx, mask, shard, rkey, ckey, K, n_act
+                )
             # stratified cohort: this shard samples its own clients (LOCAL
             # ids); keys use GLOBAL client ids so the host mirror matches.
             # Under elastic bucketing the shard samples its full BUCKET
@@ -298,10 +339,89 @@ class ShardedFedAvg(FedAvgSim):
         )(*operands)
         return new_state, metrics
 
+    def _bulk_shard_body(self, state, x, y, idx, mask, shard, rkey,
+                         ckey, K, n_act):
+        """One shard's bulk round body (runs inside the shard_map):
+        stream THIS shard's sub-cohort through fixed-size blocks
+        folding O(model) partials, then psum the partials over the
+        client axis and run the SAME
+        :func:`~fedml_tpu.algorithms.fedavg.server_update_from_partials`
+        finalize as the single-device bulk round (replicated on every
+        shard, like the stacked path's server step). The collectives
+        shrink from stacked wmean/gather to one psum of partials."""
+        cfg = self.cfg.fed
+        S = self._shard_slots
+        draw = (
+            min(S, K) if self._elastic else self.cohort_per_shard
+        )
+        local = R.sample_stratum(ckey, shard, K, draw)
+        pad = S - draw
+        if pad:
+            local = jnp.concatenate(
+                [local, jnp.zeros((pad,), jnp.int32)]
+            )
+        if n_act is not None:
+            live = E.active_mask(S, n_act // self.n_client_shards)
+        elif S != self.cohort_per_shard:
+            live = E.active_mask(S, self.cohort_per_shard)
+        else:
+            live = None
+
+        def fold_block(block_ids, block_live):
+            ckeys = jax.vmap(
+                lambda c: R.client_key(rkey, shard * K + c)
+            )(block_ids)
+            stacked_vars, n_k, msums = jax.vmap(
+                self.local_update, in_axes=(None, 0, 0, None, None, 0)
+            )(state.variables, idx[block_ids], mask[block_ids], x, y,
+              ckeys)
+            if block_live is not None:
+                stacked_vars, n_k, msums = E.mask_padded(
+                    stacked_vars, n_k, msums, state.variables,
+                    block_live,
+                )
+            # the sharded stacked path carries no non-finite screen
+            # (adversary configs are rejected at construction) — the
+            # bulk twin mirrors it: rejected stays 0
+            return fold_block_partials(
+                cfg, self.cfg.train, self.steps_per_epoch,
+                self.batch_size, state, stacked_vars, n_k, msums,
+                jnp.zeros((), jnp.float32),
+            )
+
+        partials = BK.stream_blocks(
+            fold_block, local, live, self._block_size
+        )
+        partials = jax.tree.map(
+            lambda v: jax.lax.psum(v, self.client_axis), partials
+        )
+        new_state = server_update_from_partials(
+            cfg, state, partials, rkey
+        )
+        fin = finalize_sums(partials.msums)
+        return new_state, {
+            "train_loss": fin["loss"], "train_acc": fin["acc"],
+        }
+
+    def _program_key(self) -> tuple:
+        return (self._shard_blocks, self._block_size)
+
     def _round_operand(self):
         return self.banks
 
     def run_round(self, state):
+        if self._bulk.enabled():
+            self._note_bulk_dispatch()
+            key = self._program_key()
+            if not self._elastic:
+                return self._round_fn(key, state, self.banks)
+            return E.mirror_jit_cache(
+                self._round_fn,
+                lambda: self._round_fn(
+                    key, state, self.banks,
+                    jnp.asarray(self._n_active, jnp.int32),
+                ),
+            )
         key = self.bucket_per_shard
         if not self._elastic:
             return self._round_fn(key, state, self.banks)
