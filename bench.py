@@ -1939,6 +1939,156 @@ def bulk_10k_rate_record(rounds: int, block: int = 32) -> dict:
     return rec
 
 
+def _lora_sims(rank=8, targets=("q_proj", "v_proj"),
+               which=("lora", "none")):
+    """One data/model shape for the LoRA stage, built per requested
+    ``which`` entry ('lora' = adapter-only, 'none' = full
+    fine-tuning) — callers that need one sim don't pay for two.
+    StackOverflow-SHAPED synthetic data
+    (fedml_tpu.data.natural.synthetic_stackoverflow_nwp — the same
+    seeded fallback the loader uses offline) on a small 2-layer
+    transformer."""
+    from fedml_tpu.config import (
+        DataConfig,
+        ExperimentConfig,
+        FedConfig,
+        ModelConfig,
+        TrainConfig,
+    )
+    from fedml_tpu.algorithms.fedavg import FedAvgSim
+    from fedml_tpu.data.natural import synthetic_stackoverflow_nwp
+    from fedml_tpu.models import create_model
+
+    vocab = 2000
+    data = synthetic_stackoverflow_nwp(num_clients=64,
+                                       vocab_size=vocab, seed=0)
+    model_cfg = ModelConfig(
+        name="transformer_lm", num_classes=vocab + 4, input_shape=(20,),
+        extra=(("embed_dim", 64), ("max_len", 32), ("num_heads", 4),
+               ("num_layers", 2), ("vocab_size", vocab + 4)),
+    )
+
+    def build(peft):
+        fed = FedConfig(
+            num_rounds=1000, clients_per_round=16, eval_every=10**9,
+            peft=peft, lora_rank=rank, lora_alpha=float(2 * rank),
+            lora_targets=tuple(targets),
+        )
+        cfg = ExperimentConfig(
+            data=DataConfig(dataset="stackoverflow_nwp",
+                            num_clients=64, batch_size=16, seed=0),
+            model=model_cfg, train=TrainConfig(lr=0.3, epochs=1),
+            fed=fed, seed=0,
+        )
+        return FedAvgSim(create_model(cfg.model), data, cfg)
+
+    return tuple(build(p) for p in which)
+
+
+def lora_wire_records(cohort=16, topk_frac=0.01):
+    """``wire_mb_per_round_{C}c_transformer_{full,lora}``: per-round
+    client->server update bytes of the transformer shape — the dense
+    full-model delta vs the adapter+head subtree with the topk_int8
+    codec stacked (docs/PERFORMANCE.md "Parameter-efficient federated
+    fine-tuning"). Analytic payload-byte math (the same
+    ``core.compress`` accounting the ``compress.ratio`` gauge uses;
+    marked ``"analytic": true``) — the deploy wire does not carry PEFT
+    runs, so there is no transport measurement to take. The full-delta
+    baseline is the BASE model's payload (``full_wire_bytes`` excludes
+    the adapter leaves, which a real full fine-tuning run would never
+    ship). The compound full-model-equivalent reduction is a TRACKED
+    ratio record: the >=100x acceptance bar moves a value bench_diff
+    watches."""
+    import jax
+
+    from fedml_tpu import peft as PFT
+    from fedml_tpu.core.compress import CompressionSpec, wire_ratio
+
+    (sim_lora,) = _lora_sims(which=("lora",))
+    params = jax.device_get(sim_lora.init().variables["params"])
+    plan = sim_lora._peft
+    dense_full_mb = plan.full_wire_bytes(params) / 1e6
+    cspec = CompressionSpec(method="topk_int8", topk_frac=topk_frac)
+    agg = plan.agg_part.trainable(params)
+    lora_mb = (
+        plan.adapter_wire_bytes(params) / wire_ratio(cspec, agg)
+    ) / 1e6
+    compound = PFT.compound_wire_ratio(plan, cspec, params)
+    base = {
+        "unit": "MB/round", "vs_baseline": None, "analytic": True,
+        "cohort": cohort,
+    }
+    return [
+        {"metric": f"wire_mb_per_round_{cohort}c_transformer_full",
+         "value": round(cohort * dense_full_mb, 4), **base,
+         "codec": "none"},
+        {"metric": f"wire_mb_per_round_{cohort}c_transformer_lora",
+         "value": round(cohort * lora_mb, 4), **base,
+         "codec": "topk_int8", "topk_frac": topk_frac},
+        {"metric": "lora_wire_reduction_x",
+         "value": round(compound, 1), "unit": "ratio",
+         "vs_baseline": None, "analytic": True,
+         "codec": "topk_int8", "topk_frac": topk_frac,
+         "note": "full-model dense bytes / codec-compressed "
+                 "adapter+head bytes (partition x codec, "
+                 "multiplicative); acceptance bar >= 100x"},
+    ]
+
+
+def lora_rate_record(rounds: int) -> dict:
+    """``fedavg_rounds_per_sec_64c_stackoverflow_transformer_lora``:
+    round rate of adapter-only FedAvg on the transformer NWP shape
+    (fetch-corrected best-of-3 windows like every rate record; the
+    PR 6 fallback mark rides emit() on CPU)."""
+    (sim,) = _lora_sims(which=("lora",))
+    rec = rate_record(
+        sim,
+        "fedavg_rounds_per_sec_64c_stackoverflow_transformer_lora",
+        max(6, min(rounds, 18)), None, True,
+    )
+    rec.update({
+        "peft": "lora",
+        "lora_rank": sim.cfg.fed.lora_rank,
+        "lora_targets": list(sim.cfg.fed.lora_targets),
+    })
+    return rec
+
+
+def lora_convergence_record(full_rounds: int = 16,
+                            max_lora_rounds: int = 48) -> dict:
+    """``rounds_to_match_full_transformer_lora``: the convergence pin
+    vs full-delta fine-tuning — train the FULL model ``full_rounds``
+    rounds, then count the rounds adapter-only FedAvg needs to reach
+    95% of that test accuracy on the SAME shape (lower is better;
+    ``reached: false`` with value = the budget when it never gets
+    there — an honest failure, not a silent success)."""
+    sim_lora, sim_full = _lora_sims()
+    state = sim_full.init()
+    for _ in range(full_rounds):
+        state, _ = sim_full.run_round(state)
+    full_acc = sim_full.evaluate_global(state)["acc"]
+    target = 0.95 * full_acc
+    state = sim_lora.init()
+    used, acc = max_lora_rounds, 0.0
+    for r in range(max_lora_rounds):
+        state, _ = sim_lora.run_round(state)
+        acc = sim_lora.evaluate_global(state)["acc"]
+        if acc >= target:
+            used = r + 1
+            break
+    return {
+        "metric": "rounds_to_match_full_transformer_lora",
+        "value": used,
+        "unit": "rounds",
+        "vs_baseline": None,
+        "reached": acc >= target,
+        "target_acc": round(target, 5),
+        "full_acc": round(full_acc, 5),
+        "full_rounds": full_rounds,
+        "lora_acc": round(acc, 5),
+    }
+
+
 # the probe replicates the platform selection bench itself uses (honor
 # JAX_PLATFORMS even though sitecustomize pins the platform via
 # jax.config — same escape hatch as experiments/run.py)
@@ -2123,6 +2273,16 @@ def main():
                          "from REAL block-streamed training of all "
                          "10k sampled clients (not the open-loop "
                          "discrete-event model)")
+    ap.add_argument("--lora-bench", action="store_true",
+                    help="ONLY the PEFT/LoRA stage "
+                         "(docs/PERFORMANCE.md 'Parameter-efficient "
+                         "federated fine-tuning'): adapter-only "
+                         "FedAvg round rate on the transformer NWP "
+                         "shape, per-round wire MB full vs "
+                         "codec-stacked adapters (tracked compound "
+                         "reduction ratio, >=100x acceptance bar), "
+                         "and the rounds-to-match-full-fine-tuning "
+                         "convergence pin")
     ap.add_argument("--fallback-only", action="store_true",
                     help="emit ONLY the marked CPU-fallback record "
                          "(+ one small labeled CPU measurement): the "
@@ -2265,6 +2425,13 @@ def main():
             emit(rec)
         emit(staged("bulk_rate",
                     lambda: bulk_10k_rate_record(args.rounds)))
+        return
+    if args.lora_bench:
+        for rec in staged("lora_wire", lora_wire_records):
+            emit(rec)
+        emit(staged("lora_rate",
+                    lambda: lora_rate_record(args.rounds)))
+        emit(staged("lora_convergence", lora_convergence_record))
         return
     if args.async_bench:
         for rec in staged("async", async_bench_records):
@@ -2435,6 +2602,19 @@ def main():
                     lambda: bulk_10k_rate_record(args.rounds)))
     except Exception as err:
         print(f"[bench] bulk stage failed: {err}", file=sys.stderr,
+              flush=True)
+    try:
+        # PEFT/LoRA (docs/PERFORMANCE.md "Parameter-efficient
+        # federated fine-tuning"): adapter-only transformer rate +
+        # wire-reduction + convergence-vs-full pins — tracked by
+        # bench_diff from this PR on (ROADMAP item 1 acceptance)
+        for rec in staged("lora_wire", lora_wire_records):
+            emit(rec)
+        emit(staged("lora_rate",
+                    lambda: lora_rate_record(args.rounds)))
+        emit(staged("lora_convergence", lora_convergence_record))
+    except Exception as err:
+        print(f"[bench] lora stage failed: {err}", file=sys.stderr,
               flush=True)
     sim, _ = build_sim(model_name="resnet56")
     emit(staged(

@@ -202,6 +202,16 @@ echo "== bulk smoke: O(block) streaming round + convergence + bulk.* gauges =="
 # (docs/PERFORMANCE.md "Bulk-client execution")
 JAX_PLATFORMS=cpu python scripts/bulk_smoke.py "$OUT/bulk"
 
+echo "== lora smoke: adapter-only federated fine-tuning on the tiny transformer =="
+# the PEFT subsystem end-to-end on CPU: adapter-only FedAvg on the
+# tiny transformer NWP shape learns (loss strictly down), the frozen
+# base is bitwise the init values after every round, per-round wire
+# bytes with the codec stacked are >= 50x below the full-delta
+# payload, the donation audit reports 0 misses on the partitioned
+# round, and the peft.* vocabulary is live on a real /metrics scrape
+# (docs/PERFORMANCE.md "Parameter-efficient federated fine-tuning")
+JAX_PLATFORMS=cpu python scripts/lora_smoke.py "$OUT/lora"
+
 echo "== fuse smoke: --fuse_rounds 4 parity + one compile per (bucket, K) =="
 # a tiny sim fused at K=4 must reproduce the unfused run's final loss,
 # compile exactly one block program per (bucket, block length), log a

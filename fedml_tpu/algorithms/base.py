@@ -235,6 +235,7 @@ def build_local_update(
     max_n: int,
     data_axis: str | None = None,
     data_axis_size: int = 1,
+    partition=None,
 ):
     """Build ``local_update(global_vars, idx_row, mask_row, x, y, rng)``.
 
@@ -252,6 +253,15 @@ def build_local_update(
     ``batch_size // data_axis_size`` slice of every batch and gradients are
     ``psum``-ed — the TPU analog of the reference's intra-silo DDP
     (``fedavg_cross_silo/DistWorker.py:52-54``, NCCL allreduce per batch).
+
+    ``partition`` (a :class:`fedml_tpu.peft.partition.ParamPartition`)
+    restricts training to the TRAINABLE params subtree: gradients,
+    optimizer state, the scan carry, and the RETURNED ``new_vars["params"]``
+    all live at O(trainable) — the frozen base is closed over as a
+    constant (it reaches the forward via a structural merge that costs
+    nothing at runtime), takes no optimizer step, and never appears in
+    the client's update. With ``partition=None`` (the default) every
+    code path below is byte-identical to its pre-PEFT self.
     """
     assert max_n % batch_size == 0, (max_n, batch_size)
     assert batch_size % data_axis_size == 0, (batch_size, data_axis_size)
@@ -265,10 +275,15 @@ def build_local_update(
     _to_compute_vars = lambda sv: _static_vars_to_dtype(sv, compute_dtype)
     _to_f32 = lambda t: _tree_floats_back(t, compute_dtype)
 
-    def loss_fn(params, static_vars, x_b, y_b, w_b, rng, global_params):
+    def loss_fn(params, static_vars, x_b, y_b, w_b, rng, global_params,
+                frozen_params=None):
         """Weighted-SUM loss normalized by the psum-ed weight total, so that
         psum of per-shard grads equals the exact full-batch gradient even
-        with masked (padded) samples."""
+        with masked (padded) samples. Under a partition ``params`` is the
+        trainable subtree only; the frozen base merges in structurally
+        (grads flow to the trainable leaves alone)."""
+        if frozen_params is not None:
+            params = partition.merge(params, frozen_params)
         if mixed:
             variables = {
                 **_to_compute_vars(static_vars),
@@ -297,6 +312,20 @@ def build_local_update(
 
     def local_update(global_vars, idx_row, mask_row, x, y, rng):
         global_params = global_vars["params"]
+        if partition is not None:
+            # frozen base: a per-round constant captured here, NOT part
+            # of the scan carry or the optimizer state — under
+            # vmap(local_update, in_axes=(None, ...)) it stays unbatched,
+            # so no [C, model] copy of the base ever materializes
+            frozen_params = partition.frozen(global_params)
+            start_params = partition.trainable(global_params)
+        else:
+            frozen_params = None
+            start_params = global_params
+        start_vars = {
+            **{k: v for k, v in global_vars.items() if k != "params"},
+            "params": start_params,
+        }
 
         def epoch_body(carry, ekey):
             variables, opt_state, msums = carry
@@ -318,7 +347,8 @@ def build_local_update(
                     k: v for k, v in variables.items() if k != "params"
                 }
                 (_, (new_vars, sums)), grads = grad_fn(
-                    params, static_vars, x_b, y_b, w_b, skey, global_params
+                    params, static_vars, x_b, y_b, w_b, skey,
+                    global_params, frozen_params,
                 )
                 if data_axis is not None:
                     grads = jax.lax.psum(grads, data_axis)
@@ -368,7 +398,7 @@ def build_local_update(
             )
             return (variables, opt_state, msums), None
 
-        opt_state = opt.init(global_vars["params"])
+        opt_state = opt.init(start_params)
         msums0 = zero_sums()
         ekeys = jax.vmap(lambda e: jax.random.fold_in(rng, e))(
             jnp.arange(cfg.epochs)
@@ -377,13 +407,13 @@ def build_local_update(
         # copies; inline tiny epoch counts instead. Bounded at 2 so the
         # program size cannot blow up as epochs x scan_unroll.
         if cfg.epochs <= 2:
-            carry = (global_vars, opt_state, msums0)
+            carry = (start_vars, opt_state, msums0)
             for e in range(cfg.epochs):
                 carry, _ = epoch_body(carry, ekeys[e])
             variables, _, msums = carry
         else:
             (variables, _, msums), _ = jax.lax.scan(
-                epoch_body, (global_vars, opt_state, msums0), ekeys
+                epoch_body, (start_vars, opt_state, msums0), ekeys
             )
         n_k = jnp.sum(mask_row)
         return variables, n_k, msums

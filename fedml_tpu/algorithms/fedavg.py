@@ -37,6 +37,8 @@ from fedml_tpu.core import elastic as E
 from fedml_tpu.core import memscope as M
 from fedml_tpu.core import random as R
 from fedml_tpu.core import robust, telemetry, tree as T
+from fedml_tpu import peft as PF
+from fedml_tpu.peft import personal as PP
 from fedml_tpu.data.federated import FederatedArrays, FederatedData, arrays_and_batch
 from fedml_tpu.algorithms.base import (
     build_cohort_local_update,
@@ -393,17 +395,42 @@ class FedAvgSim:
         # equality tests pass R.sample_clients_stratified to mirror its
         # per-shard sampling on one device.
         self.sampler = sampler or R.sample_clients
-        self.model = model
         self.cfg = cfg
         # surfaced at construction instead of the first traced round
         robust.check_fednova_compat(cfg.fed.algorithm,
                                     cfg.fed.robust_method)
+        # -- parameter-efficient fine-tuning (fedml_tpu.peft, docs/
+        # PERFORMANCE.md "Parameter-efficient federated fine-tuning"):
+        # with cfg.fed.peft='lora' the model's targeted projections are
+        # wrapped with zero-init low-rank branches and the rounds below
+        # train/aggregate ONLY the adapter + head subtree — the frozen
+        # base never grows an optimizer state, a delta, or a wire
+        # payload. Off by default: build_peft returns the model
+        # untouched and every path stays byte-identical.
+        model, self._peft = PF.build_peft(model, cfg)
+        self.model = model
+        self._adapter_bank = None  # personalization bank (init())
         self.task = make_task(data.task)
         self._prepare_data(data, cfg)
+        # token-model sanity: an embed table smaller than the data's
+        # id space makes XLA CLAMP every out-of-range lookup — the
+        # run trains and reports metrics on silently corrupted
+        # gathers. Surface it here, where both sides are known.
+        vocab = getattr(self.model.module, "vocab_size", None)
+        if (self.task.name == "nwp" and vocab is not None
+                and vocab < self.arrays.num_classes):
+            raise ValueError(
+                f"model vocab_size {vocab} < the dataset's token-id "
+                f"space {self.arrays.num_classes}: out-of-range "
+                "embedding lookups clamp silently. Set --num_classes "
+                "(or model extra vocab_size) to the dataset's vocab "
+                f"({self.arrays.num_classes})."
+            )
         max_n = self.arrays.max_client_samples
         self.steps_per_epoch = max_n // self.batch_size
         self.local_update = build_local_update(
-            model, self.task, cfg.train, self.batch_size, max_n
+            model, self.task, cfg.train, self.batch_size, max_n,
+            partition=self._peft.part if self._peft else None,
         )
         # cohort-grouped fast path: run the whole cohort as ONE widened
         # network instead of vmapping per-client nets (same numerics,
@@ -475,6 +502,10 @@ class FedAvgSim:
             # the bulk engine streams the VMAPPED update per block (the
             # widened cohort network would bake C back into one program)
             and not self._bulk.enabled()
+            # the partitioned local update is the vmapped builder's
+            # (no cohort-eligible architecture is LoRA-injectable
+            # today; stated rather than assumed)
+            and self._peft is None
             else None
         )
         self.evaluator = build_evaluator(model, self.task)
@@ -488,7 +519,16 @@ class FedAvgSim:
         # byte-identical (no extra operand, no residual allocation).
         self._cspec = C.CompressionSpec.from_fed(cfg.fed, seed=cfg.seed)
         self._ef_residual = None  # lazy zero carry, [bucket, ...]
-        donate = (0, 3) if self._cspec.enabled() else (0,)
+        if self._peft is not None and self._peft.personalized:
+            # the private adapter bank rides as a donated operand
+            # (arg 4 of _round) exactly like the EF residual would —
+            # compress+personalize is rejected, so the two never
+            # coexist
+            donate = (0, 4)
+        elif self._cspec.enabled():
+            donate = (0, 3)
+        else:
+            donate = (0,)
         # the round program is an instrumented AOT site
         # (core/memscope.py): compiles are explicit .lower().compile()
         # calls — byte-identical lowering to a first jit call — so
@@ -556,11 +596,41 @@ class FedAvgSim:
             self.cfg.fed.server_lr,
             self.cfg.fed.server_momentum,
         )
+        # PEFT: server optimizer state + momentum live at the
+        # AGGREGATED subtree's shape only (adapters + head, or the
+        # shared head under personalization) — the frozen base never
+        # grows server-side state
+        opt_params = (
+            variables["params"] if self._peft is None
+            else self._peft.agg_part.trainable(variables["params"])
+        )
+        if self._peft is not None:
+            self._note_peft(variables)
         return ServerState(
             variables=variables,
-            opt_state=opt.init(variables["params"]),
-            momentum=T.tree_zeros_like(variables["params"]),
+            opt_state=opt.init(opt_params),
+            momentum=T.tree_zeros_like(opt_params),
             round=jnp.asarray(0, jnp.int32),
+        )
+
+    def _note_peft(self, variables) -> None:
+        """Host-side PEFT accounting at init (docs/OBSERVABILITY.md
+        ``peft.*`` vocabulary) — one attribute check when telemetry
+        is off."""
+        m = telemetry.METRICS
+        if not m.enabled:
+            return
+        params = variables["params"]
+        trainable, frozen = self._peft.counts(params)
+        m.gauge("peft.trainable_params", float(trainable))
+        m.gauge("peft.frozen_params", float(frozen))
+        m.gauge(
+            "peft.adapter_wire_mb",
+            self._peft.adapter_wire_bytes(params) / 1e6,
+        )
+        m.gauge(
+            "peft.wire_ratio",
+            PF.compound_wire_ratio(self._peft, self._cspec, params),
         )
 
     # -- elastic cohort control (core/elastic.py) --------------------------
@@ -766,6 +836,13 @@ class FedAvgSim:
         cfg = self.cfg.fed
         rkey = R.round_key(self.root_key, state.round)
         skey = jax.random.fold_in(rkey, 0)
+        # PEFT view: partials, healing, and the server step fold only
+        # the aggregated subtree (local updates keep the FULL state —
+        # the frozen base is needed for the forward pass)
+        view = (
+            state if self._peft is None
+            else self._peft.view_state(state)
+        )
         if n_active is not None:
             # elastic: full-grid draw, live prefix = the traced cohort
             ids = self._sample_slot_ids(skey, arrays.num_clients)
@@ -796,21 +873,21 @@ class FedAvgSim:
               ckeys)
             if self.cfg.adversary.enabled():
                 stacked_vars = self._inject_adversaries(
-                    state, arrays, stacked_vars, block_ids
+                    view, arrays, stacked_vars, block_ids
                 )
             if block_live is not None:
                 # padded slots (partial final block / elastic headroom)
                 # healed exactly like a bucketed stacked round's
                 stacked_vars, n_k, msums = E.mask_padded(
-                    stacked_vars, n_k, msums, state.variables,
+                    stacked_vars, n_k, msums, view.variables,
                     block_live,
                 )
             stacked_vars, n_k, rejected = self._screen_nonfinite(
-                state, stacked_vars, n_k
+                view, stacked_vars, n_k
             )
             return fold_block_partials(
                 cfg, self.cfg.train, self.steps_per_epoch,
-                self.batch_size, state, stacked_vars, n_k, msums,
+                self.batch_size, view, stacked_vars, n_k, msums,
                 rejected,
             )
 
@@ -818,8 +895,10 @@ class FedAvgSim:
             fold_block, ids, live, self._block_size
         )
         new_state = server_update_from_partials(
-            cfg, state, partials, rkey
+            cfg, view, partials, rkey
         )
+        if self._peft is not None:
+            new_state = self._peft.merge_state(new_state, state)
         fin = finalize_sums(partials.msums)
         train_metrics = {
             "train_loss": fin["loss"],
@@ -828,21 +907,111 @@ class FedAvgSim:
         }
         return new_state, train_metrics
 
+    def _personal_round(self, state: ServerState,
+                        arrays: FederatedArrays, bank):
+        """Personalized PEFT round (fedml_tpu.peft.personal,
+        docs/PERFORMANCE.md "Parameter-efficient federated
+        fine-tuning"): each sampled client trains with ITS OWN private
+        adapter row merged into the shared model; only the shared
+        (head) subtree is aggregated, and the trained adapter rows are
+        scattered back into the bank. The no-leak contract is
+        structural: the aggregated view simply does not contain the
+        private paths, and the bank scatter writes each row from its
+        own client's update only. Returns ``(state, metrics, bank)``."""
+        cfg = self.cfg.fed
+        plan = self._peft
+        rkey = R.round_key(self.root_key, state.round)
+        cohort = self.sampler(
+            jax.random.fold_in(rkey, 0),
+            arrays.num_clients,
+            cfg.clients_per_round,
+        )
+        ckeys = jax.vmap(lambda c: R.client_key(rkey, c))(cohort)
+        priv_rows = PP.gather_rows(bank, cohort)
+        base_frozen = plan.private.frozen(state.variables["params"])
+
+        def one(priv, idx_row, mask_row, key):
+            params_c = plan.private.merge(priv, base_frozen)
+            vars_c = {**state.variables, "params": params_c}
+            out_vars, n_k, msums = self.local_update(
+                vars_c, idx_row, mask_row, arrays.x, arrays.y, key
+            )
+            trained = out_vars["params"]  # adapters + head, pruned
+            shared = {
+                **{k: v for k, v in out_vars.items() if k != "params"},
+                "params": plan.private.frozen(trained),
+            }
+            return shared, plan.private.trainable(trained), n_k, msums
+
+        stacked_shared, new_priv, n_k, msums = jax.vmap(one)(
+            priv_rows, arrays.idx[cohort], arrays.mask[cohort], ckeys
+        )
+
+        view = plan.view_state(state)
+        # the non-finite screen covers BOTH halves of a client's
+        # update: a poisoned client contributes nothing to the shared
+        # aggregate AND keeps its pre-round bank row (the private twin
+        # of the dense path's heal-to-global)
+        ok = robust.finite_client_mask(
+            {"shared": stacked_shared, "private": new_priv}, n_k
+        )
+
+        def heal(s, g):
+            m = ok.reshape((-1,) + (1,) * (s.ndim - 1))
+            return jnp.where(m, s, g)
+
+        stacked_shared = jax.tree.map(
+            lambda s, g: heal(s, g[None].astype(s.dtype)),
+            stacked_shared, view.variables,
+        )
+        new_priv = jax.tree.map(heal, new_priv, priv_rows)
+        n_k = jnp.where(ok, n_k, jnp.zeros_like(n_k))
+        rejected = (ok.shape[0] - jnp.sum(ok)).astype(jnp.float32)
+
+        new_view = server_update(
+            cfg, self.cfg.train, self.steps_per_epoch,
+            self.batch_size, view, stacked_shared, n_k, rkey,
+            local_reducer(),
+        )
+        new_state = plan.merge_state(new_view, state)
+        new_bank = PP.scatter_rows(bank, cohort, new_priv)
+        fin = finalize_sums(jax.tree.map(jnp.sum, msums))
+        train_metrics = {
+            "train_loss": fin["loss"],
+            "train_acc": fin["acc"],
+            "nonfinite_rejected": rejected,
+        }
+        return new_state, train_metrics, new_bank
+
     def _round(self, state: ServerState, arrays: FederatedArrays,
-               n_active=None, residual=None):
+               n_active=None, residual=None, bank=None):
         if self._bulk.enabled():
             # compression (and so the residual operand) is rejected at
             # construction in bulk mode — the python-level dispatch
             # keeps the stacked trace below byte-identical when off
             return self._bulk_round(state, arrays, n_active)
+        if bank is not None:
+            # personalized PEFT: private adapter bank in, bank out
+            # (fedml_tpu.peft.personal; incompatible combos were
+            # rejected at construction, so n_active/residual are None)
+            return self._personal_round(state, arrays, bank)
         cfg = self.cfg.fed
         stacked_vars, n_k, msums, rkey, cohort = self._locals(
             state, arrays, n_active
         )
+        # PEFT: the aggregation half of the round sees the pruned VIEW
+        # of the state — deltas, healing, the wire model, and the
+        # server step are all O(aggregated subtree); the frozen base is
+        # re-merged bitwise at the end (fedml_tpu.peft.partition).
+        # Without peft the view IS the state: zero added work.
+        view = (
+            state if self._peft is None
+            else self._peft.view_state(state)
+        )
 
         if self.cfg.adversary.enabled():
             stacked_vars = self._inject_adversaries(
-                state, arrays, stacked_vars, cohort
+                view, arrays, stacked_vars, cohort
             )
         live = (
             E.active_mask(self._bucket, n_active)
@@ -854,7 +1023,7 @@ class FedAvgSim:
             # its (possibly adversarial) delta, THEN the server pads /
             # screens what it decompressed
             stacked_vars, new_residual = self._wire_roundtrip(
-                state, stacked_vars, residual, rkey, live
+                view, stacked_vars, residual, rkey, live
             )
         if live is not None:
             # elastic bucketing: the padded slots beyond the live
@@ -863,10 +1032,10 @@ class FedAvgSim:
             # indistinguishable from absent — and they must not pollute
             # the round's train metrics either
             stacked_vars, n_k, msums = E.mask_padded(
-                stacked_vars, n_k, msums, state.variables, live
+                stacked_vars, n_k, msums, view.variables, live
             )
         stacked_vars, n_k, rejected = self._screen_nonfinite(
-            state, stacked_vars, n_k
+            view, stacked_vars, n_k
         )
 
         new_state = server_update(
@@ -874,13 +1043,15 @@ class FedAvgSim:
             self.cfg.train,
             self.steps_per_epoch,
             self.batch_size,
-            state,
+            view,
             stacked_vars,
             n_k,
             rkey,
             local_reducer(),
             valid=live,
         )
+        if self._peft is not None:
+            new_state = self._peft.merge_state(new_state, state)
         reduced = jax.tree.map(jnp.sum, msums)
         fin = finalize_sums(reduced)
         train_metrics = {
@@ -951,11 +1122,12 @@ class FedAvgSim:
         compressed = self._cspec.enabled()
         if compressed and self._ef_residual is None:
             self._ef_residual = C.zero_residual(
-                state.variables, self._bucket
+                self._wire_template(state.variables), self._bucket
             )
             telemetry.METRICS.gauge(
                 "compress.ratio",
-                C.wire_ratio(self._cspec, state.variables),
+                C.wire_ratio(self._cspec,
+                             self._wire_template(state.variables)),
             )
         operand = self._round_operand()
         n = (
@@ -999,6 +1171,17 @@ class FedAvgSim:
             self._slots - self._n_active, rounds=rounds,
         )
 
+    def _wire_template(self, variables):
+        """What one client's update payload looks like on the wire:
+        the full variables, or the aggregated PEFT subtree — the
+        error-feedback residual and the codec accounting are sized by
+        this (an O(cohort x adapter) carry under peft, never
+        O(cohort x model))."""
+        return (
+            variables if self._peft is None
+            else self._peft.agg_variables(variables)
+        )
+
     # -- public API --------------------------------------------------------
     def run_round(self, state: ServerState):
         if self._bulk.enabled():
@@ -1011,14 +1194,36 @@ class FedAvgSim:
                 self._round_fn,
                 lambda: self._round_fn(key, state, self.arrays, n),
             )
+        if self._peft is not None and self._peft.personalized:
+            # the bank is a donated operand and comes back updated —
+            # the same thread-through discipline as the EF residual.
+            # Created LAZILY on the first round (from the CURRENT
+            # state's init-valued adapters) so that the repo's
+            # re-call-init()-for-a-snapshot idiom can never reset a
+            # trained bank mid-run; its lifetime is the simulator's.
+            if self._adapter_bank is None:
+                self._adapter_bank = PP.init_bank(
+                    self._peft, state.variables["params"],
+                    self.arrays.num_clients,
+                )
+                telemetry.METRICS.gauge(
+                    "peft.personal_bank_mb",
+                    PP.bank_bytes(self._adapter_bank) / 1e6,
+                )
+            state, m, self._adapter_bank = self._round_fn(
+                self._bucket, state, self.arrays, None, None,
+                self._adapter_bank,
+            )
+            return state, m
         compressed = self._cspec.enabled()
         if compressed and self._ef_residual is None:
             self._ef_residual = C.zero_residual(
-                state.variables, self._bucket
+                self._wire_template(state.variables), self._bucket
             )
             telemetry.METRICS.gauge(
                 "compress.ratio",
-                C.wire_ratio(self._cspec, state.variables),
+                C.wire_ratio(self._cspec,
+                             self._wire_template(state.variables)),
             )
         key = self._bucket
         if not self._elastic:

@@ -370,6 +370,11 @@ class SecureFedAvgSim:
             "robust_norm_clip": f.robust_norm_clip > 0,
             "robust_noise_stddev": f.robust_noise_stddev > 0,
             "fednova": f.algorithm == "fednova",
+            # the masked-sum protocol ravels the FULL variables tree;
+            # the PEFT partition's pruned stacked updates would
+            # misalign with it (fedml_tpu.peft) — refuse, don't drift
+            "peft": getattr(f, "peft", "none") not in (None, "",
+                                                       "none"),
         }
         bad = [k for k, v in unsupported.items() if v]
         if bad:

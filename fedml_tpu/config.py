@@ -205,6 +205,28 @@ class FedConfig:
     # writes slo_rank<r>.json verdicts at shutdown. Empty = no engine,
     # no per-round work.
     slos: tuple[str, ...] = ()
+    # parameter-efficient fine-tuning (fedml_tpu.peft,
+    # docs/PERFORMANCE.md "Parameter-efficient federated
+    # fine-tuning"): "lora" wraps the transformer's targeted Dense
+    # projections with zero-init low-rank branches and restricts
+    # training + aggregation to the adapter + LM-head subtree — the
+    # frozen base takes no optimizer state, builds no delta, and
+    # ships no wire bytes. "none" (default) leaves every path
+    # byte-identical.
+    peft: str = "none"
+    # LoRA rank r (>= 1) and scale alpha (branch = (alpha/r) * x A B)
+    lora_rank: int = 4
+    lora_alpha: float = 8.0
+    # which named TransformerLM projections get adapters
+    # (q_proj/k_proj/v_proj/attn_out/mlp_up/mlp_down; the classic
+    # LoRA default is the attention q/v pair)
+    lora_targets: tuple[str, ...] = ("q_proj", "v_proj")
+    # personalization (fedml_tpu.peft.personal): keep each client's
+    # adapters in a PRIVATE per-client bank — only the shared head
+    # aggregates, and client i's adapters never reach the server or
+    # client j. Plain per-round FedAvgSim path only (bulk/elastic/
+    # compress/fuse/sharded/adversary combos are rejected loudly).
+    peft_personalize: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -313,10 +335,11 @@ class ExperimentConfig:
                     # json round-trips the adversary rank tuple as a
                     # list; restore for hashability under jit
                     v = tuple(int(r) for r in v)
-                if k == "slos" and isinstance(v, Sequence) \
+                if k in ("slos", "lora_targets") \
+                        and isinstance(v, Sequence) \
                         and not isinstance(v, str):
-                    # json round-trips the SLO spec tuple as a list;
-                    # restore for hashability under jit
+                    # json round-trips string tuples as lists; restore
+                    # for hashability under jit
                     v = tuple(str(s) for s in v)
                 kw[k] = v
             return cls(**kw)

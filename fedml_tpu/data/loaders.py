@@ -484,6 +484,19 @@ def load_dataset(cfg: DataConfig) -> FederatedData:
     ``fedml_experiments/distributed/fedavg/main_fedavg.py:133-351`` and
     ``fedml_experiments/standalone/utils/dataset.py:32-168``)."""
     name = cfg.dataset.lower()
+    if name == "synthetic_stackoverflow_nwp":
+        # checked BEFORE the synthetic_(a)_(b) prefix family below:
+        # the EXPLICITLY-REQUESTED seeded StackOverflow-shaped
+        # stand-in (same vocab ids and [B, T] int32 contract as the
+        # real TFF split) — how CI/bench run the transformer workload
+        # without the 3424-client download. Deliberately a distinct
+        # dataset name: a typo'd --data_dir on the real dataset must
+        # hard-fail, never silently train on synthetic data
+        from fedml_tpu.data.natural import synthetic_stackoverflow_nwp
+
+        return synthetic_stackoverflow_nwp(
+            num_clients=cfg.num_clients, seed=cfg.seed
+        )
     if name.startswith("synthetic"):
         # "synthetic", "synthetic_1_1", "synthetic_0.5_0.5" ...
         parts = name.split("_")

@@ -507,16 +507,120 @@ def stackoverflow_to_sequences(
     return out
 
 
+def synthetic_stackoverflow_nwp(
+    num_clients: int = 64,
+    vocab_size: int = 10000,
+    seq_len: int = 20,
+    seed: int = 0,
+    sentences_low: int = 16,
+    sentences_high: int = 96,
+) -> FederatedData:
+    """Seeded StackOverflow-SHAPED next-word-prediction stand-in: the
+    exact ``[B, T]`` int32 contract of :func:`load_stackoverflow_nwp`
+    without the 3424-client TFF download — ids 0=pad, 1..V words,
+    V+1=bos, V+2=eos, V+3=oov; every sequence starts at bos, short
+    sentences close with eos then pad; x = tokens[:, :-1],
+    y = tokens[:, 1:].
+
+    Content is a sparse Markov chain over a Zipf-weighted vocabulary
+    with a per-client successor bias, so the token stream is learnable
+    AND naturally non-IID across clients (the property the federated
+    fine-tuning benchmark exercises). Client sizes are seeded-uneven
+    like the real split. Surfaced as the EXPLICIT dataset name
+    ``synthetic_stackoverflow_nwp`` (data/loaders.py) and as
+    :func:`load_stackoverflow_nwp`'s ``fallback_clients`` opt-in, so
+    CI and the bench can run the transformer workload offline — the
+    real dataset name with missing files still fails loudly."""
+    rng = np.random.default_rng(seed)
+    V = vocab_size
+    bos, eos, oov = V + 1, V + 2, V + 3
+    # Zipf-ish unigram table + a sparse global successor table: each
+    # word has 8 likely successors; a client remaps a seeded slice of
+    # them, so clients share a language but not a distribution
+    ranks = np.arange(1, V + 1, dtype=np.float64)
+    unigram = (ranks ** -1.1) / np.sum(ranks ** -1.1)
+    succ = rng.integers(1, V + 1, (V, 8))
+
+    def client_sentences(crng, n):
+        bias = crng.integers(1, V + 1, 32)
+        out = np.zeros((n, seq_len + 1), np.int32)
+        out[:, 0] = bos
+        lengths = crng.integers(seq_len // 2, seq_len + 1, n)
+        word = crng.choice(V, size=n, p=unigram).astype(np.int64) + 1
+        for t in range(seq_len):
+            live = t < lengths
+            nxt = succ[word - 1, crng.integers(0, 8, n)]
+            # client bias: 25% of continuations come from the
+            # client's own 32-word pool — the non-IID signal
+            take_bias = crng.random(n) < 0.25
+            nxt = np.where(take_bias, bias[crng.integers(0, 32, n)], nxt)
+            # sprinkle oov like real tokenization does
+            nxt = np.where(crng.random(n) < 0.02, oov, nxt)
+            out[:, t + 1] = np.where(live, nxt, 0)
+            # the Markov chain walks words only — an oov token leaves
+            # the chain at its previous word
+            word = np.where(live & (nxt <= V), nxt, word)
+        # close short sentences with eos (position lengths[i] + 1)
+        short = lengths < seq_len
+        out[np.arange(n)[short], lengths[short] + 1] = eos
+        return out
+
+    train, test = [], []
+    for c in range(num_clients):
+        crng = np.random.default_rng((seed, c))
+        n = int(crng.integers(sentences_low, sentences_high + 1))
+        seqs = client_sentences(crng, n + max(2, n // 10))
+        tr, te = seqs[:n], seqs[n:]
+        train.append((tr[:, :-1], tr[:, 1:]))
+        test.append((te[:, :-1], te[:, 1:]))
+    x_tr, y_tr, tr_map = _natural_maps(train)
+    x_te, y_te, te_map = _natural_maps(test)
+    return FederatedData(
+        x_tr, y_tr, x_te, y_te, tr_map, te_map, V + 4, "nwp"
+    )
+
+
 def load_stackoverflow_nwp(
-    data_dir: str, vocab_size: int = 10000, seq_len: int = 20
+    data_dir: str, vocab_size: int = 10000, seq_len: int = 20,
+    fallback_clients: int | None = None, fallback_seed: int = 0,
 ) -> FederatedData:
     """stackoverflow next-word prediction from the TFF h5 pair (reference
     ``stackoverflow_nwp/data_loader.py`` + ``dataset.py``:
     ``stackoverflow_train.h5`` / ``stackoverflow_test.h5``, group
     ``examples/<client_id>/tokens`` of utf-8 sentences, word vocabulary from
     ``stackoverflow.word_count``). x = tokens[:, :-1], y = tokens[:, 1:]
-    (shifted LM targets over all positions, TFF's evaluation convention)."""
+    (shifted LM targets over all positions, TFF's evaluation convention).
+
+    ``fallback_clients`` is an EXPLICIT library opt-in: when set and
+    the TFF files are absent, the seeded
+    :func:`synthetic_stackoverflow_nwp` stand-in loads instead (same
+    vocab ids, same ``[B, T]`` int32 contract) with a LOUD stderr
+    notice. The default (None) hard-fails like every real-file loader
+    — a typo'd ``data_dir`` must never silently train on synthetic
+    data. The CLI surface for the stand-in is the distinct dataset
+    name ``synthetic_stackoverflow_nwp`` (data/loaders.py)."""
+    import sys
+
     wc = os.path.join(data_dir, "stackoverflow.word_count")
+    train_p = os.path.join(data_dir, "stackoverflow_train.h5")
+    test_p = os.path.join(data_dir, "stackoverflow_test.h5")
+    missing = [p for p in (wc, train_p, test_p)
+               if not os.path.exists(p)]
+    if missing and fallback_clients is not None:
+        # ANY absent file of the TFF triple triggers the opt-in
+        # fallback (a partial download must not half-work)
+        print(
+            f"warning: {missing[0]} not found — loading the SEEDED "
+            f"synthetic StackOverflow-shaped stand-in "
+            f"({fallback_clients} clients; fedml_tpu.data.natural."
+            "synthetic_stackoverflow_nwp). Results are not "
+            "comparable to the real TFF split.",
+            file=sys.stderr,
+        )
+        return synthetic_stackoverflow_nwp(
+            num_clients=fallback_clients, vocab_size=vocab_size,
+            seq_len=seq_len, seed=fallback_seed,
+        )
     _require(wc, "fake_stackoverflow_nwp")
     word_dict = _read_word_count(wc, vocab_size)
 
@@ -525,8 +629,8 @@ def load_stackoverflow_nwp(
         return seqs[:, :-1], seqs[:, 1:]
 
     return _build_text_federated(
-        os.path.join(data_dir, "stackoverflow_train.h5"),
-        os.path.join(data_dir, "stackoverflow_test.h5"),
+        train_p,
+        test_p,
         read_client,
         len(word_dict) + 4,
         "nwp",

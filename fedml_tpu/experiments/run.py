@@ -163,6 +163,44 @@ def parse_args(argv=None) -> tuple[ExperimentConfig, argparse.Namespace]:
                    help="leaf rank: global client id of this leaf's "
                         "slot 0 (default: contiguous equal-size "
                         "blocks per leaf rank)")
+    # -- parameter-efficient fine-tuning (fedml_tpu.peft;
+    # docs/PERFORMANCE.md "Parameter-efficient federated
+    # fine-tuning") --------------------------------------------------------
+    p.add_argument("--peft", type=str, default=None,
+                   choices=["none", "lora"],
+                   help="parameter-efficient fine-tuning: 'lora' "
+                        "wraps the transformer's targeted Dense "
+                        "projections with zero-init low-rank "
+                        "branches and trains/aggregates ONLY the "
+                        "adapter + LM-head subtree — the frozen base "
+                        "takes no optimizer state, builds no delta, "
+                        "and ships no wire bytes (composes "
+                        "multiplicatively with --compress). "
+                        "Transformer models + FedAvg-family sims "
+                        "only; round 0 is byte-identical to the base "
+                        "model")
+    p.add_argument("--lora_rank", type=int, default=None,
+                   help="LoRA rank r (>= 1); the adapter branch is "
+                        "(alpha/r) * x A B with A [in, r] seeded and "
+                        "B [r, out] zero-init")
+    p.add_argument("--lora_alpha", type=float, default=None,
+                   help="LoRA scale alpha (> 0)")
+    p.add_argument("--lora_targets", type=str, nargs="+", default=None,
+                   help="which named TransformerLM projections get "
+                        "adapters (subset of q_proj k_proj v_proj "
+                        "attn_out mlp_up mlp_down; default: the "
+                        "classic q_proj v_proj pair); resolved "
+                        "against the model's Dense names at parse "
+                        "time")
+    p.add_argument("--peft_personalize", action="store_true",
+                   help="keep each client's adapters in a PRIVATE "
+                        "per-client bank — only the shared LM head "
+                        "aggregates; client i's adapters never reach "
+                        "the server or client j "
+                        "(fedml_tpu.peft.personal). Plain per-round "
+                        "simulator path only: bulk/elastic/compress/"
+                        "fuse/sharded/adversary combos are rejected "
+                        "at parse time")
     # -- seeded Byzantine adversary injection (core/adversary.py) ----------
     p.add_argument("--adversary_mode", type=str, default=None,
                    choices=["none", "sign_flip", "scale_boost", "gauss",
@@ -509,6 +547,13 @@ def parse_args(argv=None) -> tuple[ExperimentConfig, argparse.Namespace]:
             client_block_size=a.client_block_size,
             fuse_rounds=a.fuse_rounds,
             slos=tuple(a.slo) if a.slo else None,
+            peft=a.peft,
+            lora_rank=a.lora_rank,
+            lora_alpha=a.lora_alpha,
+            lora_targets=(
+                tuple(a.lora_targets) if a.lora_targets else None
+            ),
+            peft_personalize=True if a.peft_personalize else None,
         ),
         adversary=rep(
             cfg.adversary,
@@ -594,6 +639,68 @@ def parse_args(argv=None) -> tuple[ExperimentConfig, argparse.Namespace]:
                     "without the streaming wrapper and wins",
                     file=sys.stderr,
                 )
+        # PEFT/LoRA: the whole spec (rank >= 1, alpha > 0, targets
+        # resolved against the model's Dense names) and the
+        # personalization compatibility matrix fail HERE, not at
+        # simulator construction (fedlint parse-time-validation
+        # discipline). Algorithm families outside the FedAvg-family
+        # round program would silently fine-tune the FULL model under
+        # a 'lora' label — rejected, not warned. Like the bulk gate
+        # above, the matrix applies only to processes that will RUN a
+        # simulator: under --role/--supervise the flag is inert
+        # (warned below, keyed on the merged config) and a shared
+        # sim-oriented config must not hard-fail a rank PEFT cannot
+        # affect.
+        from fedml_tpu.config import FedConfig as _FC
+
+        _fd = _FC()  # field defaults, to detect MERGED-config drift
+        if cfg.fed.peft == "none" and not cfg.fed.peft_personalize \
+                and (cfg.fed.lora_rank != _fd.lora_rank
+                     or cfg.fed.lora_alpha != _fd.lora_alpha
+                     or cfg.fed.lora_targets != _fd.lora_targets):
+            # lora_* knobs without peft='lora' — keyed on the MERGED
+            # config (a --config JSON carrying lora_* but no peft key
+            # is the same footgun as the bare flags): say so loudly
+            # rather than letting the user think a LoRA run was
+            # configured
+            print(
+                "warning: lora_rank/lora_alpha/lora_targets are "
+                "inert without peft='lora' — this run fine-tunes the "
+                "FULL model",
+                file=sys.stderr,
+            )
+        if cfg.fed.peft != "none" or cfg.fed.peft_personalize:
+            from fedml_tpu.peft import (
+                LoRASpec, check_model_supported, check_peft_compat,
+            )
+
+            LoRASpec.from_fed(cfg.fed)
+            if a.role is not None or a.supervise:
+                # PEFT covers the compiled simulators only; the deploy
+                # actors ship full deltas. Keyed on the MERGED config
+                # (not the bare CLI flag) so a --config JSON carrying
+                # fed.peft cannot silently measure full fine-tuning
+                # under a 'lora' label.
+                print(
+                    "warning: peft covers the compiled simulators "
+                    "(FedAvgSim/ShardedFedAvg) and is inert under "
+                    "--role/--supervise — this deployment trains and "
+                    "ships the FULL model (docs/PERFORMANCE.md "
+                    "'Parameter-efficient federated fine-tuning')",
+                    file=sys.stderr,
+                )
+            else:
+                check_peft_compat(cfg.fed, cfg.adversary,
+                                  checkpoint_every=cfg.checkpoint_every)
+                check_model_supported(cfg.model.name)
+                if cfg.fed.algorithm not in _ADVERSARY_SIMS:
+                    raise ValueError(
+                        f"--peft covers the FedAvg-family compiled "
+                        f"round ({sorted(_ADVERSARY_SIMS)}); the "
+                        f"{cfg.fed.algorithm!r} simulator would "
+                        "silently fine-tune the full model under a "
+                        "'lora' label"
+                    )
         if cfg.fed.slos:
             from fedml_tpu.core.slo import parse_specs
 
@@ -749,6 +856,8 @@ def _deploy_config(a) -> "DeployConfig":
             "--role (docs/PERFORMANCE.md 'Bulk-client execution')",
             file=sys.stderr,
         )
+    # (peft inertness under --role/--supervise is warned at parse
+    # time, keyed on the MERGED config so --config JSON is covered)
     if a.recovery_extensions and not a.round_deadline:
         # fail at argument time with the pairing rule, not per-rank
         # (under a supervisor the server would otherwise crash-loop on

@@ -155,9 +155,12 @@ def create_model(cfg: ModelConfig) -> FedModel:
     if name in ("transformer", "transformer_lm"):
         from fedml_tpu.models.transformer import TransformerLM
 
+        # vocab defaults to num_classes so the CLI's --num_classes is
+        # sufficient for token datasets (an under-sized embed table
+        # silently corrupts every out-of-range lookup)
         return FedModel(
             TransformerLM(
-                vocab_size=extra.get("vocab_size", 90),
+                vocab_size=extra.get("vocab_size", nc),
                 num_layers=extra.get("num_layers", 2),
                 num_heads=extra.get("num_heads", 4),
                 embed_dim=extra.get("embed_dim", 128),

@@ -15,7 +15,7 @@ whose attention implementation is injected, so the SAME module runs
 
 from __future__ import annotations
 
-from typing import Callable
+from typing import Any, Callable
 
 import flax.linen as nn
 import jax
@@ -25,11 +25,25 @@ from fedml_tpu.ops.ring_attention import full_attention
 
 AttnFn = Callable[..., jax.Array]  # (q, k, v, causal=...) -> out
 
+#: dense factory: (features, use_bias, name) -> nn.Module. None = stock
+#: nn.Dense. The PEFT subsystem (fedml_tpu.peft.lora.dense_factory)
+#: substitutes LoRA-wrapped projections for targeted names without
+#: touching this module's structure or the attn_fn contract.
+DenseFactory = Any
+
+
+def _dense(factory: DenseFactory, features: int, use_bias: bool,
+           name: str) -> nn.Module:
+    if factory is None:
+        return nn.Dense(features, use_bias=use_bias, name=name)
+    return factory(features, use_bias, name)
+
 
 class Block(nn.Module):
     num_heads: int
     mlp_ratio: int = 4
     attn_fn: AttnFn = full_attention
+    dense_cls: DenseFactory = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -39,9 +53,9 @@ class Block(nn.Module):
         # parallelism each is column-sharded on its own output dim, so
         # shards align with head boundaries (a fused 3c projection sharded
         # contiguously would cut across q/k/v and force extra resharding)
-        q = nn.Dense(c, use_bias=False, name="q_proj")(h)
-        k = nn.Dense(c, use_bias=False, name="k_proj")(h)
-        v = nn.Dense(c, use_bias=False, name="v_proj")(h)
+        q = _dense(self.dense_cls, c, False, "q_proj")(h)
+        k = _dense(self.dense_cls, c, False, "k_proj")(h)
+        v = _dense(self.dense_cls, c, False, "v_proj")(h)
         hd = c // self.num_heads
 
         def heads(z):
@@ -49,11 +63,11 @@ class Block(nn.Module):
 
         a = self.attn_fn(heads(q), heads(k), heads(v), causal=True)
         a = a.reshape(b, t, c)
-        x = x + nn.Dense(c, use_bias=False, name="attn_out")(a)
+        x = x + _dense(self.dense_cls, c, False, "attn_out")(a)
         h = nn.LayerNorm()(x)
-        h = nn.Dense(self.mlp_ratio * c, name="mlp_up")(h)
+        h = _dense(self.dense_cls, self.mlp_ratio * c, True, "mlp_up")(h)
         h = nn.gelu(h)
-        x = x + nn.Dense(c, name="mlp_down")(h)
+        x = x + _dense(self.dense_cls, c, True, "mlp_down")(h)
         return x
 
 
@@ -64,6 +78,7 @@ class TransformerLM(nn.Module):
     embed_dim: int = 128
     max_len: int = 2048
     attn_fn: AttnFn = full_attention
+    dense_cls: DenseFactory = None
 
     @nn.compact
     def __call__(self, tokens, train: bool = False, positions=None):
@@ -78,9 +93,14 @@ class TransformerLM(nn.Module):
             positions
         )
         for _ in range(self.num_layers):
-            x = Block(self.num_heads, attn_fn=self.attn_fn)(x, train=train)
+            x = Block(
+                self.num_heads, attn_fn=self.attn_fn,
+                dense_cls=self.dense_cls,
+            )(x, train=train)
         x = nn.LayerNorm()(x)
-        return nn.Dense(self.vocab_size, use_bias=False)(x)
+        # named so the PEFT partition (fedml_tpu.peft.partition) can
+        # select the head subtree as densely-trainable by path
+        return nn.Dense(self.vocab_size, use_bias=False, name="lm_head")(x)
 
 
 def make_sequence_parallel_lm_step(

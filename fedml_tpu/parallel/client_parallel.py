@@ -103,6 +103,19 @@ class ShardedFedAvg(FedAvgSim):
                 "serialized wire); model the codec on FedAvgSim or "
                 "the --role deploy path, or set compress='none'"
             )
+        if getattr(cfg.fed, "peft_personalize", False):
+            # the per-client adapter bank is a single-device donated
+            # operand; sharding it over the client axis (per-shard
+            # bank slices + the gather/scatter seam) is future work —
+            # reject rather than silently train a shared-adapter run
+            # under a "personalized" label
+            raise ValueError(
+                "peft_personalize is not wired into the mesh-sharded "
+                "runtime (the private adapter bank lives on one "
+                "device); run personalized PEFT on FedAvgSim, or drop "
+                "peft_personalize (non-personalized peft='lora' "
+                "composes with the sharded round)"
+            )
         self.mesh = mesh
         self.client_axis = cfg.mesh.client_axis_name
         self.data_axis = cfg.mesh.data_axis_name
@@ -136,6 +149,9 @@ class ShardedFedAvg(FedAvgSim):
         # builds the per-shard banks; rebuild the local update with the
         # data axis threaded through, then wrap the round in shard_map.
         super().__init__(model, data, cfg)
+        # NOTE: super().__init__ may have LoRA-injected the model
+        # (fedml_tpu.peft) — rebuilds below must use the injected one
+        model = self.model
         if self.n_data_shards > 1:
             self.local_update = build_local_update(
                 model,
@@ -145,6 +161,7 @@ class ShardedFedAvg(FedAvgSim):
                 self.arrays.max_client_samples,
                 data_axis=self.data_axis,
                 data_axis_size=self.n_data_shards,
+                partition=self._peft.part if self._peft else None,
             )
         # per-shard cohort-grouped update (data axis 1 only: the cohort
         # network has no per-batch psum seam for intra-client DDP)
@@ -165,6 +182,8 @@ class ShardedFedAvg(FedAvgSim):
             and not self._elastic
             # the bulk engine streams the vmapped update per block
             and not self._bulk.enabled()
+            # the partitioned (PEFT) update is vmapped-only
+            and self._peft is None
             else None
         )
         # bulk-client streaming over the mesh (core/bulk.py): each
@@ -295,13 +314,21 @@ class ShardedFedAvg(FedAvgSim):
                     self.local_update, in_axes=(None, 0, 0, None, None, 0)
                 )(state.variables, idx[local], mask[local], x, y, ckeys)
 
+            # PEFT view: the psum'd aggregation below only ever sees
+            # the O(adapter) pruned subtree — the frozen base is a
+            # replicated operand merged back bitwise after the step,
+            # never re-shipped through a collective
+            view = (
+                state if self._peft is None
+                else self._peft.view_state(state)
+            )
             live = None
             if n_act is not None:
                 live = E.active_mask(
                     Kb, n_act // self.n_client_shards
                 )
                 stacked_vars, n_k, msums = E.mask_padded(
-                    stacked_vars, n_k, msums, state.variables, live
+                    stacked_vars, n_k, msums, view.variables, live
                 )
 
             new_state = server_update(
@@ -309,13 +336,15 @@ class ShardedFedAvg(FedAvgSim):
                 self.cfg.train,
                 self.steps_per_epoch,
                 self.batch_size,
-                state,
+                view,
                 stacked_vars,
                 n_k,
                 rkey,
                 red,
                 valid=live,
             )
+            if self._peft is not None:
+                new_state = self._peft.merge_state(new_state, state)
             reduced = jax.tree.map(
                 lambda v: jax.lax.psum(jnp.sum(v), self.client_axis), msums
             )
@@ -350,6 +379,10 @@ class ShardedFedAvg(FedAvgSim):
         shard, like the stacked path's server step). The collectives
         shrink from stacked wmean/gather to one psum of partials."""
         cfg = self.cfg.fed
+        view = (
+            state if self._peft is None
+            else self._peft.view_state(state)
+        )
         S = self._shard_slots
         draw = (
             min(S, K) if self._elastic else self.cohort_per_shard
@@ -377,7 +410,7 @@ class ShardedFedAvg(FedAvgSim):
               ckeys)
             if block_live is not None:
                 stacked_vars, n_k, msums = E.mask_padded(
-                    stacked_vars, n_k, msums, state.variables,
+                    stacked_vars, n_k, msums, view.variables,
                     block_live,
                 )
             # the sharded stacked path carries no non-finite screen
@@ -385,7 +418,7 @@ class ShardedFedAvg(FedAvgSim):
             # bulk twin mirrors it: rejected stays 0
             return fold_block_partials(
                 cfg, self.cfg.train, self.steps_per_epoch,
-                self.batch_size, state, stacked_vars, n_k, msums,
+                self.batch_size, view, stacked_vars, n_k, msums,
                 jnp.zeros((), jnp.float32),
             )
 
@@ -396,8 +429,10 @@ class ShardedFedAvg(FedAvgSim):
             lambda v: jax.lax.psum(v, self.client_axis), partials
         )
         new_state = server_update_from_partials(
-            cfg, state, partials, rkey
+            cfg, view, partials, rkey
         )
+        if self._peft is not None:
+            new_state = self._peft.merge_state(new_state, state)
         fin = finalize_sums(partials.msums)
         return new_state, {
             "train_loss": fin["loss"], "train_acc": fin["acc"],

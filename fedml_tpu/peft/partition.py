@@ -1,0 +1,246 @@
+"""Parameter partition: the trainable/frozen split every PEFT path rides.
+
+A PEFT run trains a tiny subtree of the model — the LoRA adapters
+(:mod:`fedml_tpu.peft.lora`) plus the LM head — and must never build a
+delta, an optimizer state, or a wire payload for the frozen base. The
+partition is expressed as a PATH PREDICATE over the flax ``params``
+tree, so it needs no materialized parameters to construct and the same
+rule prunes a single tree, a stacked ``[C, ...]`` tree, or an
+error-feedback residual identically (pruning is structural — it never
+looks at leaf shapes).
+
+Two complementary prunings and one inverse:
+
+- :meth:`ParamPartition.trainable` — keep only selected leaves
+  (empty subtrees dropped, so the pruned tree is a valid flax params
+  dict the whole aggregation stack treats like any other);
+- :meth:`ParamPartition.frozen` — the complement;
+- :meth:`ParamPartition.merge` — reassemble the full tree from the two
+  prunings (exact inverse: ``merge(trainable(p), frozen(p))`` is
+  structurally and bitwise ``p``, pinned in ``tests/test_peft.py``).
+
+:class:`PeftPlan` packages the partitions a configured run needs — the
+full trainable split, and under ``--peft_personalize`` the further
+shared(head)/private(adapter) split — plus the ``view``/``merge``
+helpers :class:`~fedml_tpu.algorithms.fedavg.FedAvgSim` wraps around
+``server_update``: the server only ever sees (and keeps optimizer
+state / momentum for) the aggregated subtree; the frozen base rides
+the carried state untouched and is re-merged bitwise after every
+round.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import numpy as np
+
+Pytree = Any
+
+#: leaf names the LoRA injection creates (fedml_tpu.peft.lora.LoRADense)
+ADAPTER_LEAVES = ("lora_a", "lora_b")
+
+
+def _prune(tree: Pytree, pred: Callable[[tuple], bool],
+           path: tuple = ()) -> Pytree | None:
+    """Keep only the leaves whose path satisfies ``pred``; drop empty
+    subtrees so the result is a valid (smaller) params dict. Returns
+    None when nothing under ``tree`` is kept."""
+    if isinstance(tree, dict):
+        out = {}
+        for k, v in tree.items():
+            kept = _prune(v, pred, path + (k,))
+            if kept is not None:
+                out[k] = kept
+        return out or None
+    return tree if pred(path) else None
+
+
+def _merge(a: Pytree | None, b: Pytree | None) -> Pytree:
+    """Deep-merge two disjoint prunings back into one tree. A path may
+    carry a leaf in at most one side (partitions are complementary by
+    construction); a collision raises rather than silently preferring
+    a side."""
+    if a is None:
+        return b
+    if b is None:
+        return a
+    if isinstance(a, dict) and isinstance(b, dict):
+        out = dict(a)
+        for k, v in b.items():
+            out[k] = _merge(a.get(k), v) if k in a else v
+        return out
+    raise ValueError(
+        "partition merge collision: both sides carry a leaf at the "
+        "same path — the two trees are not complementary prunings"
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class ParamPartition:
+    """A boolean split of a params tree, defined by a path predicate.
+
+    ``select`` takes the leaf's path (a tuple of dict keys from the
+    params root, e.g. ``("Block_0", "q_proj", "lora_a")``) and returns
+    True for the TRAINABLE side. The predicate is pure python over
+    static structure, so pruning inside a traced round costs nothing
+    at runtime."""
+
+    select: Callable[[tuple], bool]
+
+    def trainable(self, params: Pytree) -> Pytree:
+        out = _prune(params, self.select)
+        if out is None:
+            raise ValueError(
+                "partition selects no trainable leaves in this params "
+                "tree — nothing to train or aggregate"
+            )
+        return out
+
+    def frozen(self, params: Pytree) -> Pytree:
+        return _prune(params, lambda p: not self.select(p)) or {}
+
+    def merge(self, trainable: Pytree, frozen: Pytree) -> Pytree:
+        return _merge(trainable, frozen)
+
+    def mask(self, params: Pytree) -> Pytree:
+        """Pytree of python bools shaped like ``params`` (True =
+        trainable) — the optax.masked-style view, used by tests."""
+
+        def walk(tree, path):
+            if isinstance(tree, dict):
+                return {k: walk(v, path + (k,)) for k, v in tree.items()}
+            return bool(self.select(path))
+
+        return walk(params, ())
+
+
+def _leaf_count(tree: Pytree) -> int:
+    import jax
+
+    return sum(int(np.prod(np.shape(l))) for l in jax.tree.leaves(tree))
+
+
+def _leaf_bytes(tree: Pytree) -> int:
+    import jax
+
+    return sum(
+        int(np.prod(np.shape(l))) * np.dtype(l.dtype).itemsize
+        for l in jax.tree.leaves(tree)
+    )
+
+
+def adapter_partition(
+    targets: tuple[str, ...] = (),
+    head_modules: tuple[str, ...] = ("lm_head",),
+) -> ParamPartition:
+    """The LoRA run's trainable split: adapter leaves (``lora_a`` /
+    ``lora_b``) plus every top-level module named in ``head_modules``
+    (the LM head aggregates densely — it is trainable without being
+    low-rank). ``targets`` is accepted for symmetry with the injection
+    spec but unused: an adapter leaf only exists where injection put
+    one, so the leaf-name rule is already target-exact."""
+    del targets
+
+    def select(path: tuple) -> bool:
+        if path and path[-1] in ADAPTER_LEAVES:
+            return True
+        return bool(path) and path[0] in head_modules
+
+    return ParamPartition(select)
+
+
+def private_partition() -> ParamPartition:
+    """The personalization split WITHIN the trainable subtree: adapter
+    leaves are per-client PRIVATE; everything else trainable (the
+    head) is the shared subtree that aggregates."""
+    return ParamPartition(
+        lambda path: bool(path) and path[-1] in ADAPTER_LEAVES
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class PeftPlan:
+    """Everything a configured PEFT run hands the simulators.
+
+    ``part`` is the full trainable/frozen split. Under personalization,
+    ``private`` further splits the trainable subtree (adapters stay in
+    per-client banks; :mod:`fedml_tpu.peft.personal`) and the
+    AGGREGATED subtree shrinks to the shared remainder — ``agg_select``
+    is the path rule for what the server actually folds."""
+
+    part: ParamPartition
+    personalized: bool = False
+
+    @property
+    def private(self) -> ParamPartition:
+        return private_partition()
+
+    @property
+    def agg_part(self) -> ParamPartition:
+        """The partition of the FULL params tree selecting what the
+        server aggregates: the whole trainable subtree, or only its
+        shared (non-private) part under personalization."""
+        if not self.personalized:
+            return self.part
+        part, priv = self.part, self.private
+
+        return ParamPartition(
+            lambda p: part.select(p) and not priv.select(p)
+        )
+
+    # -- simulator helpers (the view/merge the rounds wrap) ----------------
+
+    def agg_variables(self, variables: Pytree) -> Pytree:
+        """Variables pruned to the aggregated subtree (non-param
+        collections — batch_stats — pass through: they aggregate like
+        the reference's full-state_dict averaging either way)."""
+        return {
+            **{k: v for k, v in variables.items() if k != "params"},
+            "params": self.agg_part.trainable(variables["params"]),
+        }
+
+    def view_state(self, state):
+        """The pruned ServerState ``server_update`` consumes: the
+        aggregated params subtree only. opt_state/momentum already
+        live at this shape (init builds them over the view)."""
+        return state._replace(variables=self.agg_variables(state.variables))
+
+    def merge_state(self, new_view, old_state):
+        """Re-merge the server step's output view with the old state's
+        non-aggregated subtree — bitwise: the frozen leaves of the new
+        state ARE the old state's buffers (XLA aliases them under
+        donation; no copy, no re-ship)."""
+        frozen = self.agg_part.frozen(old_state.variables["params"])
+        merged = {
+            **{k: v for k, v in new_view.variables.items()
+               if k != "params"},
+            "params": self.agg_part.merge(
+                new_view.variables["params"], frozen
+            ),
+        }
+        return new_view._replace(variables=merged)
+
+    # -- accounting (the peft.* observability vocabulary) ------------------
+
+    def counts(self, params: Pytree) -> tuple[int, int]:
+        """(trainable, frozen) scalar-parameter counts."""
+        return (
+            _leaf_count(self.part.trainable(params)),
+            _leaf_count(self.part.frozen(params)),
+        )
+
+    def adapter_wire_bytes(self, params: Pytree) -> int:
+        """Dense bytes of ONE client's per-round update payload (the
+        aggregated subtree) — what rides the wire before any codec."""
+        return _leaf_bytes(self.agg_part.trainable(params))
+
+    def full_wire_bytes(self, params: Pytree) -> int:
+        """Dense bytes of the FULL-DELTA baseline payload: what a
+        full-fine-tuning run of the BASE model would ship per client
+        per round. Adapter leaves are excluded — they exist only
+        because of the adapter run and belong to neither baseline
+        (counting them would inflate every reduction ratio by the
+        adapter fraction)."""
+        return _leaf_bytes(private_partition().frozen(params))

@@ -1,0 +1,85 @@
+"""Private per-client adapter banks (``--peft_personalize``).
+
+Personalized PEFT keeps each client's LoRA adapters PRIVATE: the bank
+is a stacked ``[num_clients, ...]`` pytree of adapter leaves living
+beside the simulator state (a donated round operand, like the
+compression residual), and only the SHARED trainable subtree — the LM
+head — aggregates. Every round the sampled cohort's rows are gathered
+from the bank, merged into each client's local model, trained, and
+scattered back; unsampled rows are untouched bitwise.
+
+The no-leak contract (pinned in ``tests/test_peft.py``):
+
+- the server state's adapter leaves stay bitwise at their INIT values
+  forever — client adapters never reach the aggregate (the aggregated
+  view simply does not contain the private paths);
+- client *i*'s bank row is written only from client *i*'s own local
+  update — rows never mix (the scatter is by cohort id, sampling is
+  without replacement).
+
+The global model under personalization is base + aggregated head with
+INERT adapters (``lora_b`` rows start at zero and the init rows never
+train), so global evaluation measures exactly the shared model;
+:func:`personal_variables` builds the per-client personalized model
+for local evaluation.
+
+Honest scope: personalization runs on the plain per-round
+:class:`~fedml_tpu.algorithms.fedavg.FedAvgSim` path only — bulk
+streaming, elastic buckets, wire compression, round fusion, the
+mesh-sharded runtime, and adversary injection are rejected LOUDLY at
+parse/construction (:func:`fedml_tpu.peft.check_peft_compat`), never
+silently dropped.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.peft.partition import PeftPlan, _leaf_bytes
+
+Pytree = Any
+
+
+def init_bank(plan: PeftPlan, params: Pytree, num_clients: int) -> Pytree:
+    """``[num_clients, ...]`` private-adapter bank, every row the init
+    adapter values (``lora_b = 0`` — round 0 every client IS the base
+    model, like the non-personalized path)."""
+    private = plan.private.trainable(plan.part.trainable(params))
+    return jax.tree.map(
+        lambda v: jnp.broadcast_to(
+            v[None], (num_clients,) + v.shape
+        ).astype(v.dtype),
+        private,
+    )
+
+
+def gather_rows(bank: Pytree, cohort: jax.Array) -> Pytree:
+    """The sampled cohort's private rows, stacked ``[C, ...]``."""
+    return jax.tree.map(lambda v: v[cohort], bank)
+
+
+def scatter_rows(bank: Pytree, cohort: jax.Array,
+                 rows: Pytree) -> Pytree:
+    """Write the cohort's trained rows back (ids are a without-
+    replacement draw, so no row is written twice in one round)."""
+    return jax.tree.map(
+        lambda b, r: b.at[cohort].set(r.astype(b.dtype)), bank, rows
+    )
+
+
+def bank_bytes(bank: Pytree) -> int:
+    return _leaf_bytes(bank)
+
+
+def personal_variables(plan: PeftPlan, variables: Pytree, bank: Pytree,
+                       client_id) -> Pytree:
+    """Client ``client_id``'s personalized model: the shared variables
+    with the client's private adapter row merged in — what local
+    (per-client) evaluation runs on."""
+    row = jax.tree.map(lambda v: v[client_id], bank)
+    params = variables["params"]
+    merged = plan.private.merge(row, plan.private.frozen(params))
+    return {**variables, "params": merged}

@@ -50,7 +50,11 @@ _HIGHER = ("rounds/sec", "hit_rate", "% test acc", "accuracy", "acc",
 #: "MB peak": the --mem-bench peak-HBM records (peak_round_hbm_mb_*) —
 #: memory growth is a regression; the fallback-mark rule above already
 #: keeps analytic CPU records from ever diffing against device peaks.
-_LOWER = ("seconds", "ms/round", "s", "ms", "MB/round", "MB peak")
+#: "rounds": the rounds-to-target convergence family (bench
+#: --lora-bench rounds_to_match_*, future rounds_to_acc_*) — needing
+#: more rounds is a regression.
+_LOWER = ("seconds", "ms/round", "s", "ms", "MB/round", "MB peak",
+          "rounds")
 
 
 def extract_records(text: str) -> dict[str, dict]:
