@@ -2089,6 +2089,120 @@ def lora_convergence_record(full_rounds: int = 16,
     }
 
 
+def anatomy_bench_records(rounds=20, cohorts=(64, 256)):
+    """Round-anatomy stage (``--anatomy-bench``; docs/OBSERVABILITY.md
+    "Round anatomy"): two surfaces of the attribution plane itself.
+
+    - ``phase_share_local_c{C}`` — the fraction of measured round wall
+      the anatomy plane attributes to the ``local`` phase on the
+      stacked lr round at cohort C, straight from the ``/tracez`` ring
+      (phase seconds / wall seconds over the run). A diagnostic share,
+      not an acceptance bar: it pins where the round's time GOES so a
+      perf regression shows up as a share shift, not just a slower
+      headline.
+    - ``critical_path_overhead_pct`` — the cost of attribution: round
+      rate with anatomy ON vs OFF on the SAME compiled programs
+      (warmup run first so neither timed run pays compile), as a
+      lower-is-better ``%`` record. The acceptance bar is < 2%; the
+      plane only reads clocks at syncs the loop already has, so the
+      honest expectation is noise-level.
+
+    CPU records carry the PR 6 ``"fallback": "cpu"`` mark via emit()."""
+    import time as _time
+
+    import jax
+
+    from fedml_tpu.config import (
+        DataConfig,
+        ExperimentConfig,
+        FedConfig,
+        ModelConfig,
+        TrainConfig,
+    )
+    from fedml_tpu.algorithms.fedavg import FedAvgSim
+    from fedml_tpu.core.anatomy import ANATOMY
+    from fedml_tpu.data.loaders import load_dataset
+    from fedml_tpu.models import create_model
+
+    kind = jax.devices()[0].device_kind
+    records = []
+
+    def lr_sim(c):
+        cfg = ExperimentConfig(
+            data=DataConfig(dataset="synthetic_1_1", num_clients=c,
+                            batch_size=32, seed=0),
+            model=ModelConfig(name="lr", num_classes=10,
+                              input_shape=(60,)),
+            train=TrainConfig(lr=0.1, epochs=1, cohort_fused=False),
+            fed=FedConfig(num_rounds=rounds, clients_per_round=c,
+                          eval_every=10**9),
+            seed=0,
+        )
+        return FedAvgSim(create_model(cfg.model),
+                         load_dataset(cfg.data), cfg)
+
+    was_enabled = ANATOMY.enabled
+    try:
+        overhead = None
+        for i, c in enumerate(cohorts):
+            sim = lr_sim(c)
+            # compile outside every timed window: one full warmup run
+            # (run() re-inits state, so reruns replay the same rounds)
+            ANATOMY.enabled = False
+            sim.run()
+
+            def timed_run():
+                t0 = _time.perf_counter()
+                sim.run()
+                return _time.perf_counter() - t0
+
+            # interleaved best-of-3 pairs: the lr round is ms-scale and
+            # run() re-inits data each call, so paired min-timing is
+            # what keeps host jitter from swamping the sub-2% bar
+            offs, ons = [], []
+            for _ in range(3):
+                ANATOMY.enabled = False
+                offs.append(timed_run())
+                ANATOMY.reset()  # clears the ring; also re-disables
+                ANATOMY.enabled = True
+                ons.append(timed_run())
+            off_s, on_s = min(offs), min(ons)
+            entries = ANATOMY.tracez()["entries"]
+            local = sum(e["phases"].get("local", 0.0) for e in entries)
+            wall = sum(e["wall_s"] for e in entries)
+            records.append({
+                "metric": f"phase_share_local_c{c}",
+                "value": round(100.0 * local / wall, 2) if wall else 0.0,
+                "unit": "%",
+                "vs_baseline": None,
+                "cohort": c,
+                "rounds": len(entries),
+                "wall_s": round(wall, 4),
+                "device": kind,
+            })
+            if i == 0:
+                # overhead measured once, at the smallest cohort: the
+                # per-round attribution cost is fixed (clock reads), so
+                # the cheapest round is the WORST case for the %
+                overhead = 100.0 * (on_s - off_s) / off_s
+                records.append({
+                    "metric": "critical_path_overhead_pct",
+                    "value": round(overhead, 3),
+                    "unit": "%",
+                    "vs_baseline": None,
+                    "cohort": c,
+                    "anatomy_on_s": round(on_s, 4),
+                    "anatomy_off_s": round(off_s, 4),
+                    "acceptance_lt_pct": 2.0,
+                    "device": kind,
+                })
+            del sim
+    finally:
+        ANATOMY.reset()
+        ANATOMY.enabled = was_enabled
+    return records
+
+
 # the probe replicates the platform selection bench itself uses (honor
 # JAX_PLATFORMS even though sitecustomize pins the platform via
 # jax.config — same escape hatch as experiments/run.py)
@@ -2283,6 +2397,14 @@ def main():
                          "reduction ratio, >=100x acceptance bar), "
                          "and the rounds-to-match-full-fine-tuning "
                          "convergence pin")
+    ap.add_argument("--anatomy-bench", action="store_true",
+                    help="ONLY the round-anatomy stage "
+                         "(docs/OBSERVABILITY.md 'Round anatomy'): "
+                         "phase_share_local_c{64,256} (where the "
+                         "round's wall goes, from the /tracez ring) "
+                         "and critical_path_overhead_pct (anatomy on "
+                         "vs off round rate; the < 2%% acceptance "
+                         "bar — attribution must be ~free)")
     ap.add_argument("--fallback-only", action="store_true",
                     help="emit ONLY the marked CPU-fallback record "
                          "(+ one small labeled CPU measurement): the "
@@ -2435,6 +2557,10 @@ def main():
         return
     if args.async_bench:
         for rec in staged("async", async_bench_records):
+            emit(rec)
+        return
+    if args.anatomy_bench:
+        for rec in staged("anatomy", anatomy_bench_records):
             emit(rec)
         return
     if args.wire_bench:
@@ -2590,6 +2716,16 @@ def main():
             emit(rec)
     except Exception as err:
         print(f"[bench] mem stage failed: {err}", file=sys.stderr,
+              flush=True)
+    try:
+        # round anatomy (docs/OBSERVABILITY.md "Round anatomy"):
+        # where the round's wall goes (phase shares) + the cost of
+        # asking (< 2% overhead acceptance) — tracked lower-is-better
+        # on the overhead record by bench_diff from this PR on
+        for rec in staged("anatomy", anatomy_bench_records):
+            emit(rec)
+    except Exception as err:
+        print(f"[bench] anatomy stage failed: {err}", file=sys.stderr,
               flush=True)
     try:
         # bulk-client engine (docs/PERFORMANCE.md "Bulk-client

@@ -138,6 +138,17 @@ echo "== slo smoke: live /metrics + fleet federation + SLO breach over gRPC =="
 # (docs/OBSERVABILITY.md "Live export and SLOs")
 JAX_PLATFORMS=cpu python scripts/slo_smoke.py "$OUT/slo"
 
+echo "== anatomy smoke: phase attribution + straggler naming + breach profile over gRPC =="
+# the same world shape with --anatomy on every rank: mid-run the rank-0
+# /metrics endpoint must serve the server's perf.phase.* histograms and
+# the fleet-federated clients' local phase through the strict
+# OpenMetrics checks, /tracez must serve the conserved anatomy ring,
+# the chaos-delayed client must be NAMED the dominant straggler
+# (perf.straggler.rank2), and the induced SLO breach must leave exactly
+# one jax.profiler artifact with its breach.json manifest
+# (docs/OBSERVABILITY.md "Round anatomy")
+JAX_PLATFORMS=cpu python scripts/anatomy_smoke.py "$OUT/anatomy"
+
 echo "== compress smoke: topk_int8 wire vs dense over gRPC =="
 # the same 1-server + 2-client gRPC world runs dense and under
 # --compress topk_int8: the per-type byte counters must show >=4x on

@@ -25,6 +25,7 @@ import numpy as np
 
 from fedml_tpu.config import ExperimentConfig
 from fedml_tpu.core import adversary as A
+from fedml_tpu.core.anatomy import ANATOMY
 from fedml_tpu.core import compress as CMP
 from fedml_tpu.core import elastic as E
 from fedml_tpu.core import export as EXPORT
@@ -536,6 +537,11 @@ class FedAvgServerActor(ServerManager):
                 )
         cohort = self._sample()
         self._round_t0 = time.monotonic()
+        if ANATOMY.enabled:
+            # the anatomy plane (core/anatomy.py): every deploy
+            # timestamp below is passed explicitly on the actor's own
+            # monotonic clock, so arrivals and the sync origin compare
+            ANATOMY.begin_round(self.round_idx, path="deploy")
         tr = telemetry.TRACER
         if tr is not None:
             # one trace id per round: every sync this broadcast ships
@@ -1029,6 +1035,11 @@ class FedAvgServerActor(ServerManager):
             if self._discard_locked(msg):
                 return
             self._results[msg.sender] = (params, n_k)
+        if ANATOMY.enabled:
+            # straggler attribution (core/anatomy.py): first ACCEPTED
+            # result per rank, on the same monotonic clock as
+            # _round_t0 — screened/duplicate results never count
+            ANATOMY.note_arrival(msg.sender, ts=time.monotonic())
         self._maybe_close_round(deadline_fired=False)
 
     @property
@@ -1248,6 +1259,12 @@ class FedAvgServerActor(ServerManager):
         tr = telemetry.TRACER
         if tr is not None:
             tr.log_round_end(closed_idx)
+        anat = ANATOMY.enabled
+        t_close = time.monotonic()
+        if anat:
+            # everything from sync broadcast to round close is client
+            # compute + transport from the server's seat: `wire`
+            ANATOMY.phase("wire", t_close - self._round_t0)
         m = telemetry.METRICS
         if m.enabled:
             wall = time.monotonic() - self._round_t0
@@ -1299,6 +1316,10 @@ class FedAvgServerActor(ServerManager):
                     [results[r][0] for r in included]
                 )
         weights = jnp.asarray([results[r][1] for r in included])
+        t_def_end = time.monotonic() if anat else 0.0
+        if anat:
+            # decompress + robust scoring + stack build
+            ANATOMY.phase("defense_agg", t_def_end - t_agg0)
         rkey = RND.round_key(self.root_key, self.state.round)
         if self._sharded is not None:
             # mesh-sharded update (parallel/sharded_agg.py): pads the
@@ -1334,6 +1355,7 @@ class FedAvgServerActor(ServerManager):
                 rkey,
                 local_reducer(),
             )
+        agg_s = 0.0
         if m.enabled:
             # server-side device-time accounting (core/perf.py; the
             # accounting Smart-NIC FL serving work optimizes against,
@@ -1344,6 +1366,11 @@ class FedAvgServerActor(ServerManager):
             # async exactly as before.
             jax.block_until_ready(jax.tree.leaves(self.state.variables))
             agg_s = time.monotonic() - t_agg0
+            if anat:
+                # optimizer step + device wait, net of defense_agg
+                ANATOMY.phase(
+                    "server_update", time.monotonic() - t_def_end
+                )
             wall_s = max(time.monotonic() - self._round_t0, 1e-9)
             m.observe("perf.agg_wall_s", agg_s)
             m.gauge("perf.host_wait_s", max(0.0, wall_s - agg_s))
@@ -1367,6 +1394,7 @@ class FedAvgServerActor(ServerManager):
             # live/peak bytes + headroom gauges at the same boundary
             # the wall-time accounting uses
             MEMSCOPE.MONITOR.sample(tag=f"round{closed_idx}")
+        t_ck = time.monotonic() if anat else 0.0
         if self._ckpt is not None and (
             (closed_idx + 1) % self.checkpoint_every == 0
             or closed_idx + 1 >= self.cfg.fed.num_rounds
@@ -1389,6 +1417,17 @@ class FedAvgServerActor(ServerManager):
             # server's metrics (rejoins, dedups, ...) survive the crash
             # instead of dying with the exit-time flush
             telemetry.flush_metrics()
+            if anat:
+                ANATOMY.phase("checkpoint", time.monotonic() - t_ck)
+        if anat:
+            # stragglers BEFORE end_round (end_round seals the ring
+            # entry) and both BEFORE start_round below, which opens
+            # the next round and clears the arrival table
+            ANATOMY.attribute_stragglers(
+                closed_idx, t_sync=self._round_t0, t_close=t_close,
+                t_agg_s=agg_s,
+            )
+            ANATOMY.end_round(wall_s=time.monotonic() - self._round_t0)
         if self.on_round_done is not None:
             self.on_round_done(
                 self.round_idx,
@@ -1543,6 +1582,7 @@ class FedAvgClientActor(ClientManager):
         )
         # the np.asarray conversion blocks on the async dispatch, so the
         # span covers the real device work, not just the enqueue
+        t_loc = time.monotonic()
         with telemetry.maybe_span(
             "local_update", rank=self.rank, round=round_idx,
             client=client_idx,
@@ -1572,6 +1612,7 @@ class FedAvgClientActor(ClientManager):
                     KEY_MODEL_PARAMS: jax.tree.map(np.asarray,
                                                    new_vars),
                 }
+        t_send = time.monotonic()
         self.send_message(
             Message(
                 MSG_TYPE_C2S_RESULT,
@@ -1594,6 +1635,12 @@ class FedAvgClientActor(ClientManager):
             # rank 0's fleet.perf.round_wall_s answers "p95 client
             # round time across the cohort" from one scrape
             m.observe("perf.round_wall_s", time.monotonic() - t0)
+            if ANATOMY.enabled:
+                # client-side phase attribution: local compute (incl.
+                # compression) as its own fleet-federated histogram —
+                # rank 0's fleet.perf.phase.local_s splits the cohort's
+                # round wall into compute vs wire from one scrape
+                m.observe("perf.phase.local_s", t_send - t_loc)
         if (self.leave_after_round is not None
                 and round_idx >= self.leave_after_round):
             # contribute this round's result, THEN depart gracefully:

@@ -31,6 +31,7 @@ import optax
 
 from fedml_tpu.config import ExperimentConfig, FedConfig, TrainConfig
 from fedml_tpu.core import adversary as A
+from fedml_tpu.core.anatomy import ANATOMY
 from fedml_tpu.core import bulk as BK
 from fedml_tpu.core import compress as C
 from fedml_tpu.core import elastic as E
@@ -1182,6 +1183,15 @@ class FedAvgSim:
             else self._peft.agg_variables(variables)
         )
 
+    def _anatomy_path(self) -> str:
+        """The anatomy ring's round-body label (docs/OBSERVABILITY.md
+        "Round anatomy"); ``ShardedFedAvg`` overrides it."""
+        if self._bulk.enabled():
+            return "bulk"
+        if self._peft is not None and self._peft.personalized:
+            return "personal"
+        return "stacked"
+
     # -- public API --------------------------------------------------------
     def run_round(self, state: ServerState):
         if self._bulk.enabled():
@@ -1283,16 +1293,34 @@ class FedAvgSim:
                 return self._run_fused(
                     state, metrics_sink, profiler, monitor, _time
                 )
+            # the anatomy plane (core/anatomy.py) attributes phases at
+            # sync points this loop ALREADY has — the dispatch return
+            # and the one batched device_get below — so the off path
+            # stays one attribute check and the on path adds clock
+            # reads, never a new device sync
+            anat = ANATOMY.enabled
+            path = self._anatomy_path()
             for r in range(self.cfg.fed.num_rounds):
+                if anat:
+                    ANATOMY.begin_round(r, path=path)
                 t0 = _time.perf_counter()
                 if profiler is not None:
                     profiler.start_round(r)
                 state, train_m = self.run_round(state)
+                t_disp = _time.perf_counter() if anat else 0.0
                 # ONE batched D2H for the whole metric dict instead of
                 # a device sync per leaf
                 train_m = consume_round_counters(
                     jax.device_get(dict(train_m))
                 )
+                if anat:
+                    # dispatch -> metrics-on-host: the compiled round's
+                    # device execution (the sims run the whole round as
+                    # one program, so `local` carries it; the dispatch
+                    # itself lands in host_gap)
+                    ANATOMY.phase(
+                        "local", _time.perf_counter() - t_disp
+                    )
                 record = {
                     "round": r,
                     **{k: float(v) for k, v in train_m.items()},
@@ -1304,13 +1332,20 @@ class FedAvgSim:
                 if (r + 1) % self.cfg.fed.eval_every == 0 or (
                     r == self.cfg.fed.num_rounds - 1
                 ):
+                    t_ev = _time.perf_counter() if anat else 0.0
                     test_m = self.evaluate_global(state)
+                    if anat:
+                        ANATOMY.phase(
+                            "eval", _time.perf_counter() - t_ev
+                        )
                     record.update(
                         {"test_acc": test_m["acc"],
                          "test_loss": test_m["loss"]}
                     )
                 if metrics_sink is not None:
                     metrics_sink.log(record)
+                if anat:
+                    ANATOMY.end_round()
         finally:
             if profiler is not None:
                 profiler.finish()
@@ -1352,7 +1387,15 @@ class FedAvgSim:
             if (r_last + 1) % cfg.eval_every == 0 or (
                 r_last == cfg.num_rounds - 1
             ):
+                anat = ANATOMY.enabled
+                t_ev = _time.perf_counter() if anat else 0.0
                 test_m = self.evaluate_global(box[0])
+                if anat:
+                    # the block's anatomy entry closed at the pipeline
+                    # flush; the boundary eval amends it
+                    ANATOMY.amend_last(
+                        "eval", _time.perf_counter() - t_ev
+                    )
                 last.update({"test_acc": test_m["acc"],
                              "test_loss": test_m["loss"]})
             log(last)

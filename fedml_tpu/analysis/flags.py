@@ -65,11 +65,15 @@ def check_flag_registry(parser, *, reserved=RESERVED_RUN_FLAGS,
         )
 
 
-#: flags that name ONE listener bind per world and therefore belong to
-#: rank 0 only — every client of a supervised world inheriting
-#: ``--metrics_port`` would collide on the same bind (run.py strips it
-#: from client argv; the Supervisor re-checks at spawn)
-RANK0_ONLY_FLAGS = ("--metrics_port",)
+#: flags that name ONE listener bind (or one deep-profiling session)
+#: per world and therefore belong to rank 0 only — every client of a
+#: supervised world inheriting ``--metrics_port`` would collide on the
+#: same bind, and every client inheriting ``--profile_on_breach``
+#: would arm its own jax.profiler against a per-rank SLO view when
+#: the breach the operator cares about is the round the SERVER closes
+#: (run.py strips them from client argv; the Supervisor re-checks at
+#: spawn)
+RANK0_ONLY_FLAGS = ("--metrics_port", "--profile_on_breach")
 
 
 def check_rank_argv(argv, rank: int) -> None:

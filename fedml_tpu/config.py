@@ -205,6 +205,23 @@ class FedConfig:
     # writes slo_rank<r>.json verdicts at shutdown. Empty = no engine,
     # no per-round work.
     slos: tuple[str, ...] = ()
+    # round-anatomy plane (core/anatomy.py, docs/OBSERVABILITY.md
+    # "Round anatomy"): per-phase wall-time attribution at the sync
+    # points each round path already has (perf.phase.* histograms, a
+    # dominant-phase gauge, the /tracez last-N ring), plus cross-rank
+    # straggler/critical-path accounting on the deploy server. Off
+    # (default) = one attribute check per round, byte-identical
+    # results, no listener section.
+    anatomy: bool = False
+    # SLO-breach-triggered deep profiling (core/anatomy.py
+    # BreachProfiler): arm a one-shot jax.profiler trace window fired
+    # on an SLO breach TRANSITION or the mem_headroom crossing,
+    # written under <telemetry_dir>/profiles/ with a flight-recorder
+    # event linking breach -> artifact. Requires an armed breach
+    # source (--slo or mem_headroom monitoring) and a telemetry dir.
+    profile_on_breach: bool = False
+    profile_window_s: float = 5.0  # capture window length (> 0)
+    profile_max_captures: int = 3  # lifetime capture cap (>= 1)
     # parameter-efficient fine-tuning (fedml_tpu.peft,
     # docs/PERFORMANCE.md "Parameter-efficient federated
     # fine-tuning"): "lora" wraps the transformer's targeted Dense

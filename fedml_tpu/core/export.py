@@ -256,6 +256,27 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                     code, json.dumps(doc, default=repr).encode(),
                     "application/json",
                 )
+            elif path == "/tracez":
+                # the round-anatomy ring (core/anatomy.py,
+                # docs/OBSERVABILITY.md "Round anatomy") — lazily, so
+                # a listener without the anatomy plane never imports
+                # it; 404 while the plane is off (the
+                # zero-cost-when-off rule: no section, not an empty
+                # one)
+                import sys as _sys
+
+                _an = _sys.modules.get("fedml_tpu.core.anatomy")
+                if _an is None or not _an.ANATOMY.enabled:
+                    self._send(404, b"anatomy plane off\n",
+                               "text/plain")
+                else:
+                    body = json.dumps(
+                        _an.ANATOMY.tracez(
+                            rank=telemetry.RECORDER.rank
+                        ),
+                        indent=2, default=repr,
+                    ).encode()
+                    self._send(200, body, "application/json")
             else:
                 self._send(404, b"not found\n", "text/plain")
         except Exception as err:  # scrape must not kill the server
@@ -315,7 +336,16 @@ FLEET_VERSION = 1
 #: on an otherwise idle client), and compress ratio / residual /
 #: staleness lag (gauges — each changed value is one fleet
 #: observation).
-FLEET_HISTS = ("perf.round_wall_s", "perf.local_step_s")
+FLEET_HISTS = (
+    "perf.round_wall_s",
+    "perf.local_step_s",
+    # the anatomy plane's client-side phase attribution + a leaf
+    # aggregator's subtree straggler wait (docs/OBSERVABILITY.md
+    # "Round anatomy") — histograms like the round wall, so the root's
+    # fleet percentiles cover the cohort's real distribution
+    "perf.phase.local_s",
+    "perf.straggler_wait_s",
+)
 FLEET_COUNTERS = (
     "transport.bytes_by_type.c2s_result",
     "transport.bytes_by_type.s2c_sync_model",

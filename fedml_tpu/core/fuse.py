@@ -85,14 +85,17 @@ class BlockPipeline:
     checkpoint / profiler boundaries, end of run).
 
     Flushed blocks come back as ``(start, length, rows, wall_s,
-    compiled)``: ``rows`` is one host dict per round (sliced out of the
-    ``[K, ...]`` stacked leaves — one batched transfer for the whole
-    block), ``wall_s`` spans dispatch -> metrics-on-host, i.e. the
-    block's execution in the steady state (the next block was already
-    enqueued when the flush started waiting), and ``compiled`` echoes
-    the flag the dispatcher pushed (True when this dispatch traced a
-    fresh block program — its wall is compile-dominated and must stay
-    out of the per-round SLO surface)."""
+    compiled, get_wait_s)``: ``rows`` is one host dict per round (sliced
+    out of the ``[K, ...]`` stacked leaves — one batched transfer for
+    the whole block), ``wall_s`` spans dispatch -> metrics-on-host, i.e.
+    the block's execution in the steady state (the next block was
+    already enqueued when the flush started waiting), ``compiled``
+    echoes the flag the dispatcher pushed (True when this dispatch
+    traced a fresh block program — its wall is compile-dominated and
+    must stay out of the per-round SLO surface), and ``get_wait_s`` is
+    the seconds the ``device_get`` blocked — the anatomy plane's
+    ``local`` attribution (core/anatomy.py), timed at a sync the
+    pipeline already pays."""
 
     def __init__(self) -> None:
         self._pending: tuple[int, int, Any, float, bool] | None = None
@@ -100,25 +103,29 @@ class BlockPipeline:
     def push(
         self, start: int, length: int, device_metrics: Any, t0: float,
         compiled: bool = False,
-    ) -> tuple[int, int, list[dict], float, bool] | None:
+    ) -> tuple[int, int, list[dict], float, bool, float] | None:
         prev = self.flush()
         self._pending = (start, length, device_metrics, t0, compiled)
         return prev
 
-    def flush(self) -> tuple[int, int, list[dict], float, bool] | None:
+    def flush(
+        self,
+    ) -> tuple[int, int, list[dict], float, bool, float] | None:
         if self._pending is None:
             return None
         import jax
 
         start, n, dm, t0, compiled = self._pending
         self._pending = None
+        t_get = time.perf_counter()
         host = jax.device_get(dm)  # one batched D2H for the block
         wall = time.perf_counter() - t0
+        get_wait = time.perf_counter() - t_get
         rows = [
             {k: np.asarray(v)[i] for k, v in host.items()}
             for i in range(n)
         ]
-        return start, n, rows, wall, compiled
+        return start, n, rows, wall, compiled, get_wait
 
 
 def drive(
@@ -156,13 +163,24 @@ def drive(
     excluded from the per-round SLO surface like the warmup round
     (otherwise the remainder lengths an eval/checkpoint cadence forces
     would put an XLA compile into the p99)."""
+    from fedml_tpu.core.anatomy import ANATOMY
+
     pipeline = BlockPipeline()
     seen_lengths: set[int] = set()
 
     def emit(flushed, hold_last=False):
-        start, blen, rows, wall, compiled = flushed
+        start, blen, rows, wall, compiled, get_wait = flushed
         if monitor is not None:
             monitor.note_block(wall, blen, compiled=compiled)
+        if ANATOMY.enabled:
+            # one anatomy entry per fused block: `local` is the
+            # device_get wait the flush already paid (remaining device
+            # execution in the steady state); dispatch + host row
+            # conversion land in host_gap. The driver's boundary hook
+            # amends eval/checkpoint onto this entry afterwards.
+            ANATOMY.begin_round(start, path="fused", rounds=blen)
+            ANATOMY.phase("local", get_wait)
+            ANATOMY.end_round(wall_s=wall)
         records = make_records(start, rows)
         last = records.pop() if hold_last else None
         for rec in records:

@@ -453,6 +453,20 @@ class DeviceMemoryMonitor:
                 note="device memory crossed the headroom threshold — "
                      "the next bucket/cohort growth may OOM",
             )
+            # the crossing is the second breach-profile trigger
+            # (core/anatomy.py) — lazily, like telemetry.shutdown's
+            # reset: this module must not pull anatomy in
+            import sys as _sys
+
+            _an = _sys.modules.get("fedml_tpu.core.anatomy")
+            if _an is not None:
+                try:
+                    _an.notify_mem_headroom(
+                        source=source, used_frac=round(worst_frac, 4),
+                        threshold=self.headroom_warn,
+                    )
+                except Exception:
+                    pass  # a profiler failure must not fail sampling
         _register_status()
         return summary
 

@@ -199,6 +199,11 @@ class SloEngine:
         self._registry = registry
         self._recorder = recorder
         self._clock = clock or time.monotonic
+        # breach-transition listeners (core/anatomy.py's breach
+        # profiler): called as cb(spec, breaching, value) exactly once
+        # per transition, right where the flight event records — never
+        # once per breached tick
+        self._listeners: list = []
         self._max_window = max(
             (s.window_s for s in self.specs), default=0.0
         )
@@ -207,6 +212,12 @@ class SloEngine:
         self._ring: collections.deque = collections.deque()
         self._state = {id(s): _SpecState() for s in self.specs}
         self._last_tick: float | None = None
+
+    def add_transition_listener(self, cb) -> None:
+        """Subscribe ``cb(spec, breaching, value)`` to breach
+        transitions (idempotent per callable)."""
+        if cb not in self._listeners:
+            self._listeners.append(cb)
 
     # -- evaluation --------------------------------------------------------
 
@@ -304,6 +315,11 @@ class SloEngine:
                         slo=spec.describe(), scope=spec.scope,
                         value=value, threshold=spec.threshold,
                     )
+                for cb in self._listeners:
+                    try:
+                        cb(spec, breaching, value)
+                    except Exception:
+                        pass  # a listener must not kill the evaluator
             if last is not None:
                 # the just-elapsed interval is attributed to the state
                 # this tick DETECTED (the crossing happened somewhere

@@ -762,6 +762,17 @@ def shutdown() -> None:
             _mem.reset()
     except Exception:
         pass
+    try:
+        import sys as _sys
+
+        # anatomy-plane state (phase ring, breach profiler) resets the
+        # same lazy way — an open capture window is closed here so a
+        # dangling jax.profiler session cannot break the next run
+        _an = _sys.modules.get("fedml_tpu.core.anatomy")
+        if _an is not None:
+            _an.reset()
+    except Exception:
+        pass
     METRICS.enabled = False
     METRICS.reset()
     RECORDER.enabled = False

@@ -1274,7 +1274,8 @@ def run_role(cfg: ExperimentConfig, dep: DeployConfig) -> dict:
     """Run THIS process's rank to completion; returns the rank summary."""
     if (dep.telemetry_dir or dep.trace or dep.trace_jax
             or dep.metrics_interval or dep.metrics_port is not None
-            or cfg.fed.slos):
+            or cfg.fed.slos or cfg.fed.anatomy
+            or cfg.fed.profile_on_breach):
         telemetry.configure(
             # --trace without a dir still gets dumps, in the run dir
             telemetry_dir=dep.telemetry_dir
@@ -1287,6 +1288,21 @@ def run_role(cfg: ExperimentConfig, dep: DeployConfig) -> dict:
             slos=cfg.fed.slos,
             slo_scope=cfg.run_name,
         )
+        if cfg.fed.anatomy or cfg.fed.profile_on_breach:
+            # the round-anatomy plane (core/anatomy.py) rides the
+            # telemetry dir configured above; the knobs travel in
+            # FedConfig so every rank of a world shares ONE config —
+            # the supervisor strips --profile_on_breach from client
+            # argv (rank-0-only), explicit --role launches honor what
+            # each rank's own command line says
+            from fedml_tpu.core import anatomy
+
+            anatomy.configure(
+                anatomy=cfg.fed.anatomy,
+                profile_on_breach=cfg.fed.profile_on_breach,
+                profile_window_s=cfg.fed.profile_window_s,
+                profile_max_captures=cfg.fed.profile_max_captures,
+            )
     algo = cfg.fed.algorithm
     if algo in FEDAVG_FAMILY:
         return _run_fedavg_rank(cfg, dep)

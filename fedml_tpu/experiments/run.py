@@ -389,6 +389,38 @@ def parse_args(argv=None) -> tuple[ExperimentConfig, argparse.Namespace]:
                         "event per breach transition, and writes "
                         "slo_rank<r>.json verdicts at shutdown. "
                         "Implies telemetry")
+    # -- round anatomy + breach-triggered deep profiling
+    # (core/anatomy.py; docs/OBSERVABILITY.md "Round anatomy") -------------
+    p.add_argument("--anatomy", action="store_true",
+                   help="enable the round-anatomy plane: per-phase "
+                        "wall-time attribution (perf.phase.* "
+                        "histograms + dominant-phase gauge) timed at "
+                        "the sync points each round path already has, "
+                        "a last-N-rounds /tracez ring on the "
+                        "--metrics_port listener, and cross-rank "
+                        "straggler/critical-path accounting on the "
+                        "deploy server. Off (default) costs one "
+                        "attribute check per round and keeps results "
+                        "byte-identical. Implies telemetry")
+    p.add_argument("--profile_on_breach", action="store_true",
+                   help="arm a one-shot jax.profiler deep-profile "
+                        "window fired on an SLO breach TRANSITION or "
+                        "the mem_headroom crossing, written under "
+                        "<telemetry_dir>/profiles/ with a flight "
+                        "event linking breach -> artifact path. "
+                        "Requires an armed breach source (--slo or "
+                        "--mem_headroom_warn); rank 0 only under "
+                        "--supervise (like --metrics_port). Capture "
+                        "never extends a round deadline. Implies "
+                        "telemetry")
+    p.add_argument("--profile_window_s", type=float, default=None,
+                   help="breach-profile capture window in seconds "
+                        "(> 0; default 5)")
+    p.add_argument("--profile_max_captures", type=int, default=None,
+                   help="lifetime cap on breach-profile captures "
+                        "(>= 1; default 3) — re-armed breaches after "
+                        "the cap are counted in profile.skipped, "
+                        "never captured")
     # -- process-separated deployment (reference mpirun/run_server.sh
     # surface: one OS process per rank; scripts/run_distributed.sh is the
     # localhost launcher) --------------------------------------------------
@@ -547,6 +579,10 @@ def parse_args(argv=None) -> tuple[ExperimentConfig, argparse.Namespace]:
             client_block_size=a.client_block_size,
             fuse_rounds=a.fuse_rounds,
             slos=tuple(a.slo) if a.slo else None,
+            anatomy=True if a.anatomy else None,
+            profile_on_breach=True if a.profile_on_breach else None,
+            profile_window_s=a.profile_window_s,
+            profile_max_captures=a.profile_max_captures,
             peft=a.peft,
             lora_rank=a.lora_rank,
             lora_alpha=a.lora_alpha,
@@ -715,6 +751,38 @@ def parse_args(argv=None) -> tuple[ExperimentConfig, argparse.Namespace]:
             raise ValueError(
                 f"--mem_headroom_warn is a used FRACTION of device "
                 f"memory in (0, 1], got {cfg.fed.mem_headroom_warn}"
+            )
+        # breach profiling (core/anatomy.py BreachProfiler): keyed on
+        # the MERGED config so a --config JSON carrying the knobs gets
+        # the same parse-time gate as the bare flags (fedlint
+        # parse-time-validation discipline)
+        if cfg.fed.profile_window_s <= 0:
+            raise ValueError(
+                f"--profile_window_s must be > 0, got "
+                f"{cfg.fed.profile_window_s}"
+            )
+        if cfg.fed.profile_max_captures < 1:
+            raise ValueError(
+                f"--profile_max_captures must be >= 1, got "
+                f"{cfg.fed.profile_max_captures}"
+            )
+        if cfg.fed.profile_on_breach and not cfg.fed.slos \
+                and a.mem_headroom_warn is None:
+            # without a breach SOURCE the armed profiler can never
+            # fire — the operator thinks deep profiles are coming and
+            # none ever do
+            raise ValueError(
+                "--profile_on_breach needs an armed breach source: "
+                "add --slo spec(s) and/or an explicit "
+                "--mem_headroom_warn threshold"
+            )
+        if (cfg.fed.profile_window_s != 5.0
+                or cfg.fed.profile_max_captures != 3) \
+                and not cfg.fed.profile_on_breach:
+            print(
+                "warning: --profile_window_s/--profile_max_captures "
+                "are inert without --profile_on_breach",
+                file=sys.stderr,
             )
         if a.tier_spec is not None:
             TierSpec.parse(a.tier_spec)
@@ -978,9 +1046,17 @@ def _run_supervised(a, argv: list[str]) -> int:
     clean = _strip_flags(base, prefixes=("--fault_",))
     # --metrics_port names ONE port: the server keeps it (its /metrics
     # carries the federated fleet.* view anyway); clients would all
-    # collide on the same bind, so the flag is stripped from their argv
-    c_base = _strip_flags(base, valued={"--metrics_port"})
-    c_clean = _strip_flags(clean, valued={"--metrics_port"})
+    # collide on the same bind, so the flag is stripped from their
+    # argv. --profile_on_breach is rank-0-only the same way (one deep
+    # profiler per world, armed where rounds close); its window/cap
+    # companions go with it so the clients don't warn about inert
+    # knobs. --anatomy stays on every rank: the clients' phase
+    # histograms are what fleet federation forwards.
+    _c_bare = {"--profile_on_breach"}
+    _c_valued = {"--metrics_port", "--profile_window_s",
+                 "--profile_max_captures"}
+    c_base = _strip_flags(base, bare=_c_bare, valued=_c_valued)
+    c_clean = _strip_flags(clean, bare=_c_bare, valued=_c_valued)
     entry = [sys.executable, "-m", "fedml_tpu.experiments.run"]
     specs = [
         RankSpec(
@@ -1120,7 +1196,8 @@ def main(argv=None) -> int:
         )
     if (a.telemetry_dir or a.trace or a.trace_jax
             or cfg.fed.profile_rounds or a.metrics_interval
-            or a.metrics_port is not None or cfg.fed.slos):
+            or a.metrics_port is not None or cfg.fed.slos
+            or cfg.fed.anatomy or cfg.fed.profile_on_breach):
         from fedml_tpu.core import telemetry
 
         telemetry.configure(
@@ -1134,6 +1211,17 @@ def main(argv=None) -> int:
             slos=cfg.fed.slos,
             slo_scope=cfg.run_name,
         )
+        if cfg.fed.anatomy or cfg.fed.profile_on_breach:
+            # the anatomy plane rides the telemetry dir configured
+            # above (breach profiles land under <dir>/profiles/)
+            from fedml_tpu.core import anatomy
+
+            anatomy.configure(
+                anatomy=cfg.fed.anatomy,
+                profile_on_breach=cfg.fed.profile_on_breach,
+                profile_window_s=cfg.fed.profile_window_s,
+                profile_max_captures=cfg.fed.profile_max_captures,
+            )
     summaries = Experiment(cfg, a.repetitions).run()
     for s in summaries:
         print(json.dumps(s, default=float))

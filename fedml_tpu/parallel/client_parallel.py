@@ -224,6 +224,12 @@ class ShardedFedAvg(FedAvgSim):
         # rejected above, so the block never carries a residual.
         self._round_impl = self._sharded_round
 
+    def _anatomy_path(self) -> str:
+        # the anatomy ring labels the round body actually running
+        # (docs/OBSERVABILITY.md "Round anatomy"); the inherited run
+        # loop times the mesh round at the same sync points
+        return "sharded"
+
     def set_cohort_size(self, n: int) -> None:
         """Elastic cohort change for the sharded runtime: ``n`` must
         divide evenly over the clients axis and each shard's slice must

@@ -1,0 +1,227 @@
+"""CI smoke: the round-anatomy plane end to end over a real gRPC world.
+
+A 1-server + 2-client world runs with ``--anatomy`` on every rank and
+``--metrics_port 0 --slo 'perf.round_wall_s:p99<0.3@2s'
+--profile_on_breach --profile_max_captures 1`` on the server
+(docs/OBSERVABILITY.md "Round anatomy"):
+
+- client 2 runs under a seeded chaos delay (every message +up to
+  0.8 s) and LEAVEs gracefully after round 3 — the induced slow phase
+  that (a) makes rank 2 the dominant straggler and (b) breaches the
+  tight SLO exactly once;
+- mid-run the rank-0 ``/metrics`` endpoint must serve the server's
+  ``perf.phase.*`` histograms AND the fleet-federated
+  ``fleet.perf.phase.local_s`` (from the clients' own anatomy planes)
+  through the strict OpenMetrics checks, and ``/tracez`` must serve the
+  deploy anatomy ring as JSON;
+- after the run: ``perf.straggler.rank2`` dominates ``rank1`` by no
+  less than half the injected delay, phase attribution on every ring
+  entry conserved to its wall, and EXACTLY ONE ``jax.profiler``
+  artifact under ``<telemetry_dir>/profiles/`` whose ``breach.json``
+  manifest links it to the SLO breach (``profile.captures == 1`` in the
+  final metrics snapshot — the cap held).
+
+Usage::
+
+    python scripts/anatomy_smoke.py OUT_DIR
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+from slo_smoke import _check_exposition, _env, _free_ports, _scrape  # noqa: E402
+
+ROUNDS = 200
+LEAVE_AFTER = 3
+TIGHT = "perf.round_wall_s:p99<0.3@2s"
+
+
+def main(out_dir: str) -> int:
+    os.makedirs(out_dir, exist_ok=True)
+    cfg = {
+        "data": {"dataset": "fake_mnist", "num_clients": 2,
+                 "batch_size": 32, "partition_method": "homo",
+                 "seed": 0},
+        "model": {"name": "lr", "num_classes": 10,
+                  "input_shape": [28, 28, 1]},
+        "train": {"lr": 0.1, "epochs": 1},
+        "fed": {"algorithm": "fedavg", "num_rounds": ROUNDS,
+                "clients_per_round": 2, "eval_every": ROUNDS},
+        "seed": 0,
+        "run_name": "anatomy",
+        "out_dir": out_dir,
+    }
+    cfg_path = os.path.join(out_dir, "cfg.json")
+    with open(cfg_path, "w") as f:
+        json.dump(cfg, f)
+    ports = _free_ports(3)
+    ip_path = os.path.join(out_dir, "ip.json")
+    with open(ip_path, "w") as f:
+        json.dump({str(r): ["127.0.0.1", ports[r]] for r in range(3)},
+                  f)
+    telemetry_dir = os.path.join(out_dir, "telemetry")
+    base = [sys.executable, "-m", "fedml_tpu.experiments.run",
+            "--config", cfg_path, "--backend", "grpc",
+            "--world_size", "3", "--ip_config", ip_path,
+            "--ready_timeout", "120",
+            "--telemetry_dir", telemetry_dir,
+            "--metrics_interval", "0.1",
+            "--heartbeat_interval", "0.5", "--heartbeat_timeout", "30",
+            "--quorum_fraction", "0.5", "--round_deadline", "120",
+            "--anatomy"]
+    env = _env()
+
+    def spawn(role, rank=None, extra=()):
+        argv = [*base, "--role", role, *extra]
+        if rank is not None:
+            argv += ["--rank", str(rank)]
+        return subprocess.Popen(argv, env=env, cwd=REPO,
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT, text=True)
+
+    procs = {
+        # client 1: small pacing delay so fast rounds stay well under
+        # the tight threshold while the post-breach tail drains
+        1: spawn("client", 1, extra=("--fault_delay", "1.0",
+                                     "--fault_delay_max", "0.03")),
+        # client 2: the induced straggler AND slow phase — every
+        # message +up to 0.8 s, graceful LEAVE after round 3
+        2: spawn("client", 2, extra=("--fault_delay", "1.0",
+                                     "--fault_delay_max", "0.8",
+                                     "--leave_after_round",
+                                     str(LEAVE_AFTER))),
+    }
+    server = spawn("server", extra=("--metrics_port", "0",
+                                    "--slo", TIGHT,
+                                    "--profile_on_breach",
+                                    "--profile_window_s", "2",
+                                    "--profile_max_captures", "1"))
+
+    # -- discover the ephemeral port -------------------------------------
+    export_path = os.path.join(telemetry_dir, "export_rank0.json")
+    deadline = time.monotonic() + 240
+    port = None
+    while port is None and time.monotonic() < deadline:
+        if server.poll() is not None:
+            out = server.communicate()[0]
+            for p in procs.values():
+                p.kill()
+            raise SystemExit(
+                f"server exited rc={server.returncode} before the "
+                f"exporter came up:\n{out}"
+            )
+        if os.path.exists(export_path):
+            with open(export_path) as f:
+                port = json.load(f)["port"]
+        time.sleep(0.05)
+    if port is None:
+        server.kill()
+        for p in procs.values():
+            p.kill()
+        raise SystemExit("export_rank0.json never appeared")
+
+    # -- mid-run: phase vocabulary on /metrics, anatomy ring on /tracez ---
+    types = tracez = None
+    while time.monotonic() < deadline and server.poll() is None:
+        code, metrics_text = _scrape(port, "/metrics")
+        assert code == 200
+        types = _check_exposition(metrics_text)
+        if ("perf_phase_wire_s" in types
+                and "fleet_perf_phase_local_s" in types):
+            code, tz = _scrape(port, "/tracez")
+            assert code == 200
+            tracez = json.loads(tz)
+            break
+        time.sleep(0.2)
+    assert types and types.get("perf_phase_wire_s") == "histogram", (
+        f"server phase histograms never appeared "
+        f"(types: {sorted(t for t in (types or {}))})"
+    )
+    assert types.get("fleet_perf_phase_local_s") == "histogram", (
+        "clients' perf.phase.local_s never federated into fleet.*"
+    )
+    assert tracez is not None and tracez["entries"], tracez
+    assert all(e["path"] == "deploy" for e in tracez["entries"])
+    for e in tracez["entries"]:
+        assert abs(sum(e["phases"].values()) - e["wall_s"]) <= 1e-9, e
+        assert "host_gap" in e["phases"], e
+
+    # -- wind down --------------------------------------------------------
+    s_out = server.communicate(timeout=600)[0]
+    outs = {}
+    for r, p in procs.items():
+        try:
+            outs[r] = p.communicate(timeout=60)[0]
+        except subprocess.TimeoutExpired:
+            p.kill()
+            outs[r] = p.communicate()[0]
+    if server.returncode != 0:
+        raise SystemExit(f"server failed rc={server.returncode}:\n{s_out}")
+    # stderr is merged into stdout and the profiler's stop path may log
+    # AFTER the summary line — take the last line that parses as JSON
+    summary = None
+    for line in reversed(s_out.strip().splitlines()):
+        try:
+            summary = json.loads(line)
+            break
+        except json.JSONDecodeError:
+            continue
+    assert isinstance(summary, dict) and "rounds" in summary, s_out[-2000:]
+    assert summary["rounds"] == ROUNDS, summary
+    assert summary["membership"]["left"] == [2], summary
+
+    # -- straggler attribution names the delayed rank ---------------------
+    with open(os.path.join(telemetry_dir, "metrics_rank0.json")) as f:
+        metrics = json.load(f)
+    g = metrics["gauges"]
+    # gauges freeze at the last >=2-arrival round — inside the slow
+    # phase, where rank 2's margin is the injected delay
+    assert g["perf.straggler.rank2"] - g.get("perf.straggler.rank1", 0.0) \
+        >= 0.05, g
+    assert g["perf.critical_path_s"] > 0, g
+    assert metrics["histograms"]["perf.straggler_wait_s"]["count"] >= 1
+    assert metrics["histograms"]["perf.phase.wire_s"]["count"] >= ROUNDS
+
+    # -- exactly one breach-profile artifact, linked by manifest ----------
+    profiles = sorted(glob.glob(
+        os.path.join(telemetry_dir, "profiles", "breach_*")
+    ))
+    assert len(profiles) == 1, (
+        f"expected exactly one profile artifact, got {profiles}"
+    )
+    with open(os.path.join(profiles[0], "breach.json")) as f:
+        manifest = json.load(f)
+    assert manifest["reason"].startswith("slo_"), manifest
+    assert manifest["capture"] == 1, manifest
+    assert g["profile.active"] == 0.0, "capture window never closed"
+    assert metrics["counters"]["profile.captures"] == 1, metrics["counters"]
+
+    print(json.dumps({
+        "anatomy_smoke": "ok",
+        "rounds": summary["rounds"],
+        "tracez_entries_at_scrape": len(tracez["entries"]),
+        "straggler_rank2_margin_s": round(
+            g["perf.straggler.rank2"]
+            - g.get("perf.straggler.rank1", 0.0), 4,
+        ),
+        "profile_artifact": os.path.basename(profiles[0]),
+        "breach_reason": manifest["reason"],
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) != 2:
+        raise SystemExit("usage: anatomy_smoke.py OUT_DIR")
+    sys.exit(main(sys.argv[1]))

@@ -53,8 +53,11 @@ _HIGHER = ("rounds/sec", "hit_rate", "% test acc", "accuracy", "acc",
 #: "rounds": the rounds-to-target convergence family (bench
 #: --lora-bench rounds_to_match_*, future rounds_to_acc_*) — needing
 #: more rounds is a regression.
+#: "%": the --anatomy-bench percentage records — the tracked one is
+#: critical_path_overhead_pct (attribution cost vs anatomy-off; the
+#: < 2% acceptance bar), where growth is a regression.
 _LOWER = ("seconds", "ms/round", "s", "ms", "MB/round", "MB peak",
-          "rounds")
+          "rounds", "%")
 
 
 def extract_records(text: str) -> dict[str, dict]:

@@ -98,12 +98,22 @@ def merge(paths: list[str]) -> dict:
     sends: dict[str, dict] = {}
     delivers: dict[str, dict] = {}
 
+    cp_events: list[dict] = []
+
     for ev in events:
         rank = int(ev["rank"] or 0)
         ranks.add(rank)
         ts_us = (float(ev.get("ts", ts0)) - ts0) * 1e6
         dur_us = float(ev.get("seconds", 0.0)) * 1e6
         name = ev.get("name") or ev["kind"]
+        if name == "critical_path":
+            # round-anatomy critical path (core/anatomy.py): the
+            # instant event carries the closed round's segment
+            # durations — rendered as contiguous spans on a dedicated
+            # track below, not a zero-width marker buried in rank 0's
+            # stream
+            cp_events.append(ev)
+            continue
         args = {k: v for k, v in ev.items() if k not in _STRUCTURAL}
         base = {
             "name": name,
@@ -143,6 +153,8 @@ def merge(paths: list[str]) -> dict:
             "ts": max(recv["ts"], send["ts"] + 1.0),
         })
 
+    trace_events.extend(_critical_path_track(cp_events, ts0))
+
     for r in sorted(ranks):
         label = f"rank {r}" + (" (server)" if r == 0 else "")
         trace_events.append({
@@ -158,6 +170,71 @@ def merge(paths: list[str]) -> dict:
     # internal plumbing for fold_jax_profiles; stripped before writing
     return {"traceEvents": trace_events, "displayTimeUnit": "ms",
             "_epoch0": ts0}
+
+
+#: synthetic pid for the round-anatomy critical-path track (above any
+#: real rank, below the jax-profile block)
+_CRITICAL_PATH_PID = 8000
+
+
+def _critical_path_track(cp_events: list[dict], ts0: float) -> list[dict]:
+    """Per-round critical-path spans (core/anatomy.py
+    ``attribute_stragglers``): each ``critical_path`` instant event is
+    emitted at round close and carries the closed round's segment
+    durations, so the track reconstructs the dependent chain backwards
+    from the close timestamp — ``sync -> slowest result (rank r)``
+    followed by ``aggregate`` — as contiguous ``X`` spans on one
+    synthetic process. Empty input (anatomy off, sim-only worlds)
+    yields no track at all."""
+    out: list[dict] = []
+    for ev in cp_events:
+        close_ts = float(ev.get("ts", ts0))
+        closed_after = float(ev.get("closed_after_s", 0.0))
+        sync_to_result = float(ev.get("sync_to_result_s", 0.0))
+        agg = float(ev.get("aggregate_s", 0.0))
+        # the event fires at close; the round's sync broadcast was
+        # closed_after_s earlier
+        start_us = (close_ts - ts0 - closed_after) * 1e6
+        rnd = ev.get("round")
+        rank_path = ev.get("rank_path")
+        out.append({
+            "name": f"r{rnd} sync->result rank{rank_path}",
+            "cat": "critical_path",
+            "ph": "X",
+            "pid": _CRITICAL_PATH_PID,
+            "tid": 0,
+            "ts": start_us,
+            "dur": sync_to_result * 1e6,
+            "args": {
+                "round": rnd,
+                "rank_path": rank_path,
+                "straggler_wait_s": ev.get("straggler_wait_s"),
+                "total_s": ev.get("total_s"),
+            },
+        })
+        if agg > 0:
+            out.append({
+                "name": f"r{rnd} aggregate",
+                "cat": "critical_path",
+                "ph": "X",
+                "pid": _CRITICAL_PATH_PID,
+                "tid": 0,
+                "ts": start_us + sync_to_result * 1e6,
+                "dur": agg * 1e6,
+                "args": {"round": rnd},
+            })
+    if out:
+        out.append({
+            "ph": "M", "name": "process_name",
+            "pid": _CRITICAL_PATH_PID, "tid": 0,
+            "args": {"name": "critical path (round anatomy)"},
+        })
+        out.append({
+            "ph": "M", "name": "process_sort_index",
+            "pid": _CRITICAL_PATH_PID, "tid": 0,
+            "args": {"sort_index": _CRITICAL_PATH_PID},
+        })
+    return out
 
 
 #: pid block for folded jax-profile rounds (far above any real rank)

@@ -6,37 +6,23 @@ C=10)? What do BN and the dense/residual glue cost?
 """
 from __future__ import annotations
 
-import time
 import sys
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax import lax
 
 sys.path.insert(0, str(__import__("pathlib").Path(__file__).resolve().parent.parent))
+
+from fedml_tpu.core.anatomy import fetch_corrected_time
 
 INNER = 20
 
 
 def timeit(fn, *args, n=15, warmup=2):
-    for _ in range(warmup):
-        out = fn(*args)
-    leaf = jax.tree.leaves(out)[0]
-    float(np.asarray(jax.device_get(jnp.sum(leaf))))
-    fs = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        float(np.asarray(jax.device_get(jnp.sum(leaf))))
-        fs.append(time.perf_counter() - t0)
-    fetch = min(fs)
-    t0 = time.perf_counter()
-    for _ in range(n):
-        out = fn(*args)
-    leaf = jax.tree.leaves(out)[0]
-    float(np.asarray(jax.device_get(jnp.sum(leaf))))
-    wall = time.perf_counter() - t0
-    return max(wall - fetch, wall / 2) / n / INNER
+    # ONE timing path: the shared fetch-corrected loop from the
+    # round-anatomy plane, amortized again over the INNER-step scan
+    return fetch_corrected_time(fn, *args, n=n, warmup=warmup) / INNER
 
 
 def conv_flops(B, H, W, k, ci, co):

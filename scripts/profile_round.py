@@ -5,53 +5,66 @@ Times (fetch-corrected, amortized) for the s2d headline config:
 - cohort grad_fn alone (one step's fwd+bwd)
 - one step_body equivalent (grad + optimizer + gather + gating)
 - aggregation/server_update alone
+
+Timing rides the anatomy plane's shared fetch-corrected loop
+(``fedml_tpu.core.anatomy.fetch_corrected_time`` — ONE timing path for
+every offline profiling script), the round program compiles through
+:class:`~fedml_tpu.core.memscope.ProgramSite` so the compile is timed
+and memory-accounted exactly like the production sims'
+(``mem.program.profile_round.*``), and each measured component lands in
+the round-anatomy ring as its own entry — pass ``--telemetry_dir`` to
+keep the ``perf.phase.*`` observations and the metrics snapshot.
+
 Usage: python scripts/profile_round.py [--model resnet56_s2d]
 """
 from __future__ import annotations
 
 import argparse
-import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 
-def timeit(fn, *args, n=30, warmup=2):
-    for _ in range(warmup):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    leaf = jax.tree.leaves(out)[0]
-    float(np.asarray(jax.device_get(jnp.sum(leaf))))
-    # fetch cost
-    fs = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        float(np.asarray(jax.device_get(jnp.sum(leaf))))
-        fs.append(time.perf_counter() - t0)
-    fetch = min(fs)
-    t0 = time.perf_counter()
-    for _ in range(n):
-        out = fn(*args)
-    leaf = jax.tree.leaves(out)[0]
-    float(np.asarray(jax.device_get(jnp.sum(leaf))))
-    wall = time.perf_counter() - t0
-    return max(wall - fetch, wall / 2) / n
-
-
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="resnet56_s2d")
+    ap.add_argument("--telemetry_dir", default=None,
+                    help="keep the anatomy/metrics artifacts (phase "
+                         "observations, mem.program accounting) here")
     args = ap.parse_args()
 
     import sys
     sys.path.insert(0, str(__import__("pathlib").Path(__file__).resolve().parent.parent))
     from bench import build_sim
 
+    from fedml_tpu.core import anatomy, telemetry
+    from fedml_tpu.core.anatomy import ANATOMY, fetch_corrected_time
+    from fedml_tpu.core.memscope import ProgramSite
+
+    if args.telemetry_dir:
+        telemetry.configure(telemetry_dir=args.telemetry_dir, rank=0)
+    anatomy.configure(anatomy=True)
+
+    def measure(label, phase, fn, *a, n=30):
+        """One timing path + one anatomy entry per measured component:
+        the amortized seconds land in the ring (path='profile') and the
+        perf.phase.* histogram the label maps to."""
+        ANATOMY.begin_round(len(ANATOMY.ring_snapshot()), path="profile")
+        t = fetch_corrected_time(fn, *a, n=n)
+        ANATOMY.phase(phase, t)
+        ANATOMY.end_round(wall_s=t)
+        return t
+
     sim, data = build_sim(model_name=args.model)
     state = sim.init()
-    compiled = jax.jit(sim._round).lower(state, sim.arrays).compile()
-    t_round = timeit(lambda s: compiled(s, sim.arrays)[0], state, n=40)
+    # ProgramSite: the compile is timed (mem.compile_s) and
+    # memory-accounted (mem.program.profile_round.*) — the same
+    # accounting path the sims' round programs use
+    site = ProgramSite(sim._round, family="profile_round")
+    t_round = measure("full_round", "local",
+                      lambda s: site("round", s, sim.arrays)[0], state,
+                      n=40)
     print(f"full round: {t_round*1e3:.2f} ms  ({1/t_round:.1f} r/s)")
 
     counts = np.asarray(sim.arrays.counts)
@@ -97,7 +110,8 @@ def main():
     sp = stacked["params"]
     ss = {k: v for k, v in stacked.items() if k != "params"}
     rng = jax.random.key(1)
-    t_grad = timeit(
+    t_grad = measure(
+        "cohort_grad", "local",
         lambda p: grad_fn(p, ss, x_cb, y_cb, w_cb, rng)[1], sp, n=40
     )
     print(f"cohort grad_fn: {t_grad*1e3:.2f} ms")
@@ -125,7 +139,8 @@ def main():
             new_opt, opt_state
         )
 
-    t_step = timeit(lambda v: step(v, opt_state)[0], stacked, n=40)
+    t_step = measure("step_body", "local",
+                     lambda v: step(v, opt_state)[0], stacked, n=40)
     print(f"step body (no gather): {t_step*1e3:.2f} ms")
 
     # --- data gather ---
@@ -136,12 +151,14 @@ def main():
     def gather(b_idx):
         return jnp.take(x, b_idx, axis=0)
 
-    t_g = timeit(gather, b_idx, n=40)
+    t_g = measure("data_gather", "h2d", gather, b_idx, n=40)
     print(f"data gather: {t_g*1e3:.3f} ms")
 
     # implied steps from the round
     print(f"implied: round={t_round*1e3:.1f}ms; if k steps of "
           f"{t_step*1e3:.2f}ms -> k={t_round/t_step:.1f}")
+    if args.telemetry_dir:
+        telemetry.flush_metrics()
 
 
 if __name__ == "__main__":

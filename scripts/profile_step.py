@@ -3,35 +3,17 @@ layouts, per-op grouped conv rates, and sub-cohort scaling (C=5 vs C=10).
 """
 from __future__ import annotations
 
-import time
 import sys
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax import lax
 
 sys.path.insert(0, str(__import__("pathlib").Path(__file__).resolve().parent.parent))
 
-
-def timeit(fn, *args, n=40, warmup=2):
-    for _ in range(warmup):
-        out = fn(*args)
-    leaf = jax.tree.leaves(out)[0]
-    float(np.asarray(jax.device_get(jnp.sum(leaf))))
-    fs = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        float(np.asarray(jax.device_get(jnp.sum(leaf))))
-        fs.append(time.perf_counter() - t0)
-    fetch = min(fs)
-    t0 = time.perf_counter()
-    for _ in range(n):
-        out = fn(*args)
-    leaf = jax.tree.leaves(out)[0]
-    float(np.asarray(jax.device_get(jnp.sum(leaf))))
-    wall = time.perf_counter() - t0
-    return max(wall - fetch, wall / 2) / n
+# ONE timing path: the shared fetch-corrected amortized loop from the
+# round-anatomy plane (this script used to carry its own drifting copy)
+from fedml_tpu.core.anatomy import fetch_corrected_time as timeit
 
 
 def conv_flops(B, H, W, k, ci, co):
