@@ -1939,6 +1939,162 @@ def bulk_10k_rate_record(rounds: int, block: int = 32) -> dict:
     return rec
 
 
+def bank_bench_records(cohorts=(1000, 10_000, 100_000), block=32):
+    """The client-state-bank stage (``--bank-bench``;
+    docs/FAULT_TOLERANCE.md "Client-state banks"):
+
+    - ``peak_round_hbm_mb_c{1k,10k,100k}_defended_compressed`` — the
+      fully-composed bulk round (int8 codec + EF ``ClientStateBank`` +
+      the streamed median defense) swept over a 100x cohort range at a
+      FIXED population, like :func:`bulk_mem_bench_records`. The
+      acceptance law: the program's analytic ``temp + argument`` bytes
+      stay FLAT (<= 1.5x across any 10x step) — the bank is an
+      O(population) donated operand whose bytes never scale with the
+      cohort, and the defense sketch is O(sketch), so composition must
+      not resurrect the O(C) round. ``value`` is analytic for the same
+      process-lifetime-monotone reason as the bulk rows (marked
+      ``"analytic": true``; live device peak rides as a diagnostic).
+    - ``defense_stream_overhead_ms`` — mean per-round wall of the
+      defended+compressed bulk round minus the plain bulk round at the
+      smallest sweep point: what the two-pass sketch fold actually
+      costs (lower-is-better; diagnostics carry both absolute means).
+
+    CPU records carry the PR 6 ``"fallback": "cpu"`` mark via emit()."""
+    import jax
+
+    from fedml_tpu.config import (
+        DataConfig,
+        ExperimentConfig,
+        FedConfig,
+        ModelConfig,
+        TrainConfig,
+    )
+    from fedml_tpu.algorithms.fedavg import FedAvgSim
+    from fedml_tpu.core import memscope as M
+    from fedml_tpu.core import telemetry
+    from fedml_tpu.data.loaders import make_synthetic
+    from fedml_tpu.models import create_model
+
+    was_enabled = telemetry.METRICS.enabled
+    telemetry.METRICS.enabled = True
+    records = []
+    kind = jax.devices()[0].device_kind
+    population = max(cohorts)
+    # small per-client shards: the flat-memory law under test is about
+    # POPULATION-sized operands (bank rows) vs cohort-sized temps; the
+    # per-client sample count only scales the local-epoch wall, and the
+    # LEAF default (~400 samples/client) makes the 100k-population
+    # sweep hours on the CPU fallback for no extra information
+    data = make_synthetic(population, 1.0, 1.0, seed=0,
+                          samples_low=16, samples_high=32)
+
+    def label(c):
+        return f"{c // 1000}k" if c % 1000 == 0 and c >= 1000 else str(c)
+
+    def build(cohort, defended):
+        fed_kw = (
+            dict(compress="int8", robust_method="median")
+            if defended else {}
+        )
+        cfg = ExperimentConfig(
+            data=DataConfig(dataset="synthetic_1_1",
+                            num_clients=population, batch_size=8,
+                            seed=0),
+            model=ModelConfig(name="lr", num_classes=10,
+                              input_shape=(60,)),
+            train=TrainConfig(lr=0.1, epochs=1),
+            fed=FedConfig(num_rounds=1000, clients_per_round=cohort,
+                          eval_every=10**9, client_block_size=block,
+                          **fed_kw),
+            seed=0,
+        )
+        return FedAvgSim(create_model(cfg.model), data, cfg)
+
+    def timed_rounds(sim, n=3):
+        state = sim.init()
+        state, _ = sim.run_round(state)  # warmup (compile) round
+        jax.block_until_ready(jax.tree.leaves(state))
+        t0 = time.perf_counter()
+        for _ in range(n):
+            state, _ = sim.run_round(state)
+        jax.block_until_ready(jax.tree.leaves(state))
+        return (time.perf_counter() - t0) / n * 1e3, state
+
+    try:
+        for c in cohorts:
+            sim = build(c, defended=True)
+            state = sim.init()
+            state, _ = sim.run_round(state)
+            jax.block_until_ready(jax.tree.leaves(state))
+            prog = M.program_record("sim_bulk", sim._program_key())
+            assert prog is not None, "bulk program accounting missing"
+            sample = M.MONITOR.sample(
+                tag=f"bank_mem_c{label(c)}_b{block}"
+            )
+            analytic_mb = (
+                prog["temp_bytes"] + prog["argument_bytes"]
+            ) / 1e6
+            real_peak = (
+                sample["peak_bytes"]
+                if sample and sample["source"] == "device"
+                else None
+            )
+            records.append({
+                "metric": (
+                    f"peak_round_hbm_mb_c{label(c)}"
+                    "_defended_compressed"
+                ),
+                "value": round(analytic_mb, 3),
+                "unit": "MB peak",
+                "vs_baseline": None,
+                "analytic": True,
+                "device_peak_mb": (
+                    round(real_peak / 1e6, 3) if real_peak else None
+                ),
+                "cohort": c,
+                "block_size": block,
+                "blocks": sim._n_blocks,
+                "defense": "median",
+                "compress": "int8",
+                "bank_resident_mb": round(
+                    sim._ef_bank.resident_bytes() / 1e6, 3
+                ),
+                "temp_mb": round(prog["temp_bytes"] / 1e6, 3),
+                "argument_mb": round(
+                    prog["argument_bytes"] / 1e6, 3
+                ),
+                "output_mb": round(prog["output_bytes"] / 1e6, 3),
+                "compile_s": round(prog.get("compile_s", 0.0), 3),
+                "device": kind,
+            })
+            del sim, state
+        c0 = min(cohorts)
+        sim_d = build(c0, defended=True)
+        defended_ms, _ = timed_rounds(sim_d)
+        del sim_d
+        sim_p = build(c0, defended=False)
+        plain_ms, _ = timed_rounds(sim_p)
+        del sim_p
+        records.append({
+            "metric": "defense_stream_overhead_ms",
+            "value": round(defended_ms - plain_ms, 3),
+            "unit": "ms lower-is-better",
+            "vs_baseline": None,
+            "cohort": c0,
+            "block_size": block,
+            "defended_round_ms": round(defended_ms, 3),
+            "plain_round_ms": round(plain_ms, 3),
+            "defense": "median",
+            "compress": "int8",
+            "note": "two-pass sketch fold + EF bank gather/scatter "
+                    "vs the plain one-pass bulk round",
+            "device": kind,
+        })
+    finally:
+        telemetry.METRICS.enabled = was_enabled
+    return records
+
+
 def _lora_sims(rank=8, targets=("q_proj", "v_proj"),
                which=("lora", "none")):
     """One data/model shape for the LoRA stage, built per requested
@@ -2387,6 +2543,18 @@ def main():
                          "from REAL block-streamed training of all "
                          "10k sampled clients (not the open-loop "
                          "discrete-event model)")
+    ap.add_argument("--bank-bench", action="store_true",
+                    help="ONLY the client-state-bank stage "
+                         "(docs/FAULT_TOLERANCE.md 'Client-state "
+                         "banks'): flat-memory rows peak_round_hbm_"
+                         "mb_c{1k,10k,100k}_defended_compressed for "
+                         "the fully-composed bulk round (int8 codec "
+                         "+ EF bank + streamed median defense) at a "
+                         "FIXED 100k population (<= 1.5x across any "
+                         "10x cohort step is the acceptance bar), "
+                         "plus defense_stream_overhead_ms — the "
+                         "measured per-round cost of the two-pass "
+                         "sketch fold vs the plain bulk round")
     ap.add_argument("--lora-bench", action="store_true",
                     help="ONLY the PEFT/LoRA stage "
                          "(docs/PERFORMANCE.md 'Parameter-efficient "
@@ -2547,6 +2715,10 @@ def main():
             emit(rec)
         emit(staged("bulk_rate",
                     lambda: bulk_10k_rate_record(args.rounds)))
+        return
+    if args.bank_bench:
+        for rec in staged("bank_mem", bank_bench_records):
+            emit(rec)
         return
     if args.lora_bench:
         for rec in staged("lora_wire", lora_wire_records):
@@ -2738,6 +2910,17 @@ def main():
                     lambda: bulk_10k_rate_record(args.rounds)))
     except Exception as err:
         print(f"[bench] bulk stage failed: {err}", file=sys.stderr,
+              flush=True)
+    try:
+        # client-state banks (docs/FAULT_TOLERANCE.md "Client-state
+        # banks"): the fully-composed defended+compressed bulk round
+        # stays flat across a 100x cohort sweep, and the streamed
+        # defense's measured per-round overhead — tracked by
+        # bench_diff from this PR on (ISSUE 20 acceptance)
+        for rec in staged("bank_mem", bank_bench_records):
+            emit(rec)
+    except Exception as err:
+        print(f"[bench] bank stage failed: {err}", file=sys.stderr,
               flush=True)
     try:
         # PEFT/LoRA (docs/PERFORMANCE.md "Parameter-efficient

@@ -213,6 +213,18 @@ echo "== bulk smoke: O(block) streaming round + convergence + bulk.* gauges =="
 # (docs/PERFORMANCE.md "Bulk-client execution")
 JAX_PLATFORMS=cpu python scripts/bulk_smoke.py "$OUT/bulk"
 
+echo "== statebank smoke: compress+defense+bulk e2e + SIGKILL bank restore =="
+# the client-state bank seam end-to-end on CPU: a compressed (int8),
+# median-defended, block-streamed run converges on the mnist_lr shape,
+# the composed program's argument/temp bytes stay FLAT across a 4x
+# cohort sweep with the EF bank riding as a donated operand, a
+# SIGKILLed run relaunches and restores its banks BITWISE from the
+# {"server", "bank"} checkpoint composite then finishes every round,
+# the donation audit reports 0 misses, and the bank.* / defense.*
+# vocabulary is live on /metrics (docs/FAULT_TOLERANCE.md
+# "Client-state banks")
+JAX_PLATFORMS=cpu python scripts/statebank_smoke.py "$OUT/statebank"
+
 echo "== lora smoke: adapter-only federated fine-tuning on the tiny transformer =="
 # the PEFT subsystem end-to-end on CPU: adapter-only FedAvg on the
 # tiny transformer NWP shape learns (loss strictly down), the frozen

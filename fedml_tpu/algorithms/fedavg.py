@@ -38,6 +38,8 @@ from fedml_tpu.core import elastic as E
 from fedml_tpu.core import memscope as M
 from fedml_tpu.core import random as R
 from fedml_tpu.core import robust, telemetry, tree as T
+from fedml_tpu.core import statebank as SB
+from fedml_tpu.core import streamdef as SD
 from fedml_tpu import peft as PF
 from fedml_tpu.peft import personal as PP
 from fedml_tpu.data.federated import FederatedArrays, FederatedData, arrays_and_batch
@@ -303,31 +305,41 @@ def server_update_from_partials(
     state: ServerState,
     partials: BK.RoundPartials,
     rkey: jax.Array,
+    agg_delta: Pytree | None = None,
 ) -> ServerState:
     """One server step from GLOBALLY-reduced streaming partials — the
     bulk twin of :func:`server_update`, sharing its exact tail
     (:func:`_server_delta_step`). ``partials`` must already be summed
     over every block (and every shard: the mesh runtime psums the
     O(model) partials before calling this, replacing the stacked
-    wmean/gather collectives). Only ``mean``/FedNova reduce rules reach
-    here — :func:`fedml_tpu.core.bulk.check_bulk_compat` rejected
-    everything else at construction; the assert is the traced-program
-    backstop."""
+    wmean/gather collectives). The ``mean``/FedNova reduce rules fold
+    their aggregate out of ``partials`` directly; a streamed defense
+    (:mod:`fedml_tpu.core.streamdef`) passes the sketch-decided
+    ``agg_delta`` override instead — the non-param collections still
+    reduce as weighted means of the partials, exactly what the stacked
+    reducer does under any defense rule. The assert is the
+    traced-program backstop for a reduce rule that is neither."""
     pipe = robust.DefensePipeline.from_fed(fed)
-    assert pipe.method in BK.BULK_REDUCE_RULES, pipe.method
+    assert (pipe.method in BK.BULK_REDUCE_RULES
+            or agg_delta is not None), pipe.method
     global_params = state.variables["params"]
     # the same max(Σw, 1e-12) guard tree_weighted_mean applies, so the
     # degenerate all-zero-weight round degrades identically
     denom = jnp.maximum(partials.n_sum, 1e-12)
-    agg_delta = jax.tree.map(
-        lambda s, g: (s / denom).astype(g.dtype),
-        partials.delta_wsum, global_params,
-    )
-    if fed.algorithm == "fednova":
-        # tau_eff = Σ n·tau / Σ n, exactly the stacked formula with
-        # both sums pre-reduced
-        agg_delta = T.tree_scale(
-            agg_delta, partials.tau_wsum / partials.n_sum
+    if agg_delta is None:
+        agg_delta = jax.tree.map(
+            lambda s, g: (s / denom).astype(g.dtype),
+            partials.delta_wsum, global_params,
+        )
+        if fed.algorithm == "fednova":
+            # tau_eff = Σ n·tau / Σ n, exactly the stacked formula with
+            # both sums pre-reduced
+            agg_delta = T.tree_scale(
+                agg_delta, partials.tau_wsum / partials.n_sum
+            )
+    else:
+        agg_delta = jax.tree.map(
+            lambda d, g: d.astype(g.dtype), agg_delta, global_params
         )
     agg_delta = pipe.postprocess(agg_delta, jax.random.fold_in(rkey, 1))
     new_params, new_opt_state, new_momentum = _server_delta_step(
@@ -410,7 +422,10 @@ class FedAvgSim:
         # untouched and every path stays byte-identical.
         model, self._peft = PF.build_peft(model, cfg)
         self.model = model
-        self._adapter_bank = None  # personalization bank (init())
+        # personalization bank: a client-id-keyed ClientStateBank
+        # (core/statebank.py), created lazily on the first round;
+        # `_adapter_bank` exposes its raw rows for callers
+        self._bank_adapter = None
         self.task = make_task(data.task)
         self._prepare_data(data, cfg)
         # token-model sanity: an embed table smaller than the data's
@@ -470,10 +485,18 @@ class FedAvgSim:
         # the round streams the cohort through the device in blocks of
         # B vmapped local updates, each folded into an O(model)
         # partial-sum scan carry — peak memory O(B + model), not O(C).
-        # Incompatible configs (selection defenses, compression, the
-        # gauss adversary) are rejected HERE, loudly. Off by default:
+        # Selection defenses stream as two-pass sketches
+        # (core/streamdef.py); compression and personalization keep
+        # their per-client state in client-id-keyed ClientStateBanks
+        # (core/statebank.py) riding the scan carry. Off by default:
         # the stacked round stays byte-identical.
         self._bulk = BK.BulkSpec.from_fed(cfg.fed)
+        self._stream_defense = (
+            cfg.fed.robust_method
+            if (self._bulk.enabled()
+                and cfg.fed.robust_method in SD.STREAM_METHODS)
+            else None
+        )
         if self._bulk.enabled():
             BK.check_bulk_compat(cfg.fed, cfg.adversary)
             self._block_size = self._bulk.block_size
@@ -520,6 +543,12 @@ class FedAvgSim:
         # byte-identical (no extra operand, no residual allocation).
         self._cspec = C.CompressionSpec.from_fed(cfg.fed, seed=cfg.seed)
         self._ef_residual = None  # lazy zero carry, [bucket, ...]
+        # bulk mode keeps the EF carry in a client-id-keyed
+        # ClientStateBank instead of the slot-keyed [bucket, ...] carry
+        # (the residual follows the CLIENT across rounds; core/
+        # statebank.py) — also created lazily, checkpointed alongside
+        # the adapter bank (bank_state/restore_banks)
+        self._ef_bank = None
         if self._peft is not None and self._peft.personalized:
             # the private adapter bank rides as a donated operand
             # (arg 4 of _round) exactly like the EF residual would —
@@ -570,7 +599,7 @@ class FedAvgSim:
                     "sim_bulk_block" if self._bulk.enabled()
                     else "sim_block"
                 ),
-                static_argnums=(4,), donate_argnums=donate,
+                static_argnums=(5,), donate_argnums=donate,
             )
             if self._fuse > 1 else None
         )
@@ -688,8 +717,9 @@ class FedAvgSim:
         :meth:`_sample_bucket` — a permutation when the grid covers the
         population, so the live prefix never pins the same clients).
         Slots beyond the population are dead by construction
-        (``_max_live``) and carry an arbitrary id the live mask
-        hides."""
+        (``_max_live``) and carry the out-of-range SENTINEL id
+        (``num_clients``) so they can never alias a real client's bank
+        row (core/statebank.py sentinel padding)."""
         draw = min(self._slots, num_clients)
         if draw >= num_clients:
             ids = jax.random.permutation(key, num_clients).astype(
@@ -699,10 +729,7 @@ class FedAvgSim:
             ids = jax.random.choice(
                 key, num_clients, shape=(draw,), replace=False
             ).astype(jnp.int32)
-        pad = self._slots - draw
-        if pad:
-            ids = jnp.concatenate([ids, jnp.zeros((pad,), jnp.int32)])
-        return ids
+        return SB.pad_ids(ids, self._slots, num_clients)
 
     # -- one round ---------------------------------------------------------
     def _locals(self, state: ServerState, arrays: FederatedArrays,
@@ -761,7 +788,12 @@ class FedAvgSim:
         deltas = jax.tree.map(
             lambda s, g: s - g[None], stacked_vars["params"], gp
         )
-        attacked = A.corrupt_stacked_deltas(adv, deltas, state.round)
+        # cohort keys the gauss draw per (round, client id) — chunking-
+        # independent, so the bulk engine's per-block injection is
+        # bitwise-equal to the stacked round at matched seeds
+        attacked = A.corrupt_stacked_deltas(
+            adv, deltas, state.round, cohort
+        )
         params = jax.tree.map(
             lambda s, g, a: jnp.where(
                 mask.reshape((-1,) + (1,) * (s.ndim - 1)),
@@ -823,17 +855,31 @@ class FedAvgSim:
         return stacked_vars, new_residual
 
     def _bulk_round(self, state: ServerState, arrays: FederatedArrays,
-                    n_active=None):
+                    n_active=None, ef_bank=None, adapter_bank=None):
         """The block-streamed round body (core/bulk.py,
         docs/PERFORMANCE.md "Bulk-client execution"): sample the
         cohort, chunk it into ``block_size`` slots, run each block
         through the SAME vmapped local update / adversary injection /
-        padding-heal / non-finite screen the stacked round applies,
-        and fold each block's :func:`fold_block_partials` into the
-        O(model) scan carry. Peak memory is O(block + model) — no
-        ``[C, ...]`` stacked operand ever materializes. The final
-        server step is :func:`server_update_from_partials`, which
-        shares :func:`server_update`'s exact post-reduce tail."""
+        wire roundtrip / padding-heal / non-finite screen the stacked
+        round applies, and fold each block's
+        :func:`fold_block_partials` into the O(model) scan carry. Peak
+        memory is O(block + model + sketch) — no ``[C, ...]`` stacked
+        operand ever materializes. The final server step is
+        :func:`server_update_from_partials`, which shares
+        :func:`server_update`'s exact post-reduce tail.
+
+        ``ef_bank`` (the compression error-feedback
+        :class:`~fedml_tpu.core.statebank.ClientStateBank`) and
+        ``adapter_bank`` (the PEFT personalization bank) ride the scan
+        carry and come back updated; compress+personalize stays
+        rejected, so at most one is non-None. A streamed defense
+        (:mod:`fedml_tpu.core.streamdef`) turns the body into TWO
+        passes over the same blocks: pass 1 folds partials + the
+        defense sketch (EF rows read-only), the selection/quantile
+        decision is made from the sketch, pass 2 folds the decided
+        aggregate (and performs the authoritative EF write — both
+        passes recompute the identical deterministic local updates, so
+        the roundtrip inputs match bitwise)."""
         cfg = self.cfg.fed
         rkey = R.round_key(self.root_key, state.round)
         skey = jax.random.fold_in(rkey, 0)
@@ -850,21 +896,29 @@ class FedAvgSim:
             live = E.active_mask(self._slots, n_active)
         else:
             # static: the SAME draw the stacked round makes (parity),
-            # tail slots padded with a masked dummy id
+            # tail slots padded with the out-of-range sentinel id (a
+            # pad slot must never alias a real client's bank row)
             cohort = self.sampler(
                 skey, arrays.num_clients, cfg.clients_per_round
             )
             pad = self._slots - cohort.shape[0]
-            ids = (
-                jnp.concatenate([cohort, jnp.zeros((pad,), jnp.int32)])
-                if pad else cohort
-            )
+            ids = SB.pad_ids(cohort, self._slots, arrays.num_clients)
             live = (
                 E.active_mask(self._slots, cohort.shape[0])
                 if pad else None
             )
+        if adapter_bank is not None:
+            return self._bulk_personal(
+                state, view, arrays, ids, live, rkey, adapter_bank
+            )
 
-        def fold_block(block_ids, block_live):
+        def local_block(block_ids, block_live, bank, write_bank=True):
+            """The stacked round's pre-aggregation prefix, one block at
+            a time: vmapped local updates, adversary injection, wire
+            roundtrip against the gathered EF rows, pad heal,
+            non-finite screen. Returns ``(stacked_vars, n_k, msums,
+            rejected, new_bank)`` — ``new_bank`` None unless ``bank``
+            rode in and ``write_bank`` held."""
             ckeys = jax.vmap(lambda c: R.client_key(rkey, c))(block_ids)
             idx_rows = arrays.idx[block_ids]
             mask_rows = arrays.mask[block_ids]
@@ -876,6 +930,23 @@ class FedAvgSim:
                 stacked_vars = self._inject_adversaries(
                     view, arrays, stacked_vars, block_ids
                 )
+            rows = new_rows = None
+            if bank is not None:
+                # the in-round wire model against the CLIENT-keyed EF
+                # carry (compress.roundtrip_rows): gather this block's
+                # rows, roundtrip, scatter back below once the screen
+                # has decided which rows survive
+                gp = view.variables
+                rows = bank.gather(block_ids)
+                deltas = jax.tree.map(
+                    lambda s, g: s - g[None], stacked_vars, gp
+                )
+                deq, new_rows = C.roundtrip_rows(
+                    self._cspec, deltas, rows, rkey, block_ids
+                )
+                stacked_vars = jax.tree.map(
+                    lambda g, d: (g[None] + d).astype(d.dtype), gp, deq
+                )
             if block_live is not None:
                 # padded slots (partial final block / elastic headroom)
                 # healed exactly like a bucketed stacked round's
@@ -883,20 +954,59 @@ class FedAvgSim:
                     stacked_vars, n_k, msums, view.variables,
                     block_live,
                 )
+            ok = robust.finite_client_mask(stacked_vars, n_k)
             stacked_vars, n_k, rejected = self._screen_nonfinite(
                 view, stacked_vars, n_k
             )
+            new_bank = None
+            if bank is not None and write_bank:
+                # a poisoned (or non-live) slot keeps its pre-round EF
+                # row — the carry follows the CLIENT, not the slot;
+                # sentinel pad ids are dropped by the scatter
+                keep = ok if block_live is None else ok & block_live
+                new_bank = bank.put(
+                    block_ids, new_rows, keep=keep, gathered=rows
+                )
+            return stacked_vars, n_k, msums, rejected, new_bank
+
+        def partials_of(sv, n_k, msums, rejected):
             return fold_block_partials(
                 cfg, self.cfg.train, self.steps_per_epoch,
-                self.batch_size, view, stacked_vars, n_k, msums,
-                rejected,
+                self.batch_size, view, sv, n_k, msums, rejected,
             )
 
-        partials = BK.stream_blocks(
-            fold_block, ids, live, self._block_size
-        )
+        if self._stream_defense is None:
+            if ef_bank is None:
+                def fold_block(block_ids, block_live):
+                    sv, n_k, msums, rej, _ = local_block(
+                        block_ids, block_live, None
+                    )
+                    return partials_of(sv, n_k, msums, rej)
+
+                partials = BK.stream_blocks(
+                    fold_block, ids, live, self._block_size
+                )
+                new_ef = None
+            else:
+                def fold_block(block_ids, block_live, bank):
+                    sv, n_k, msums, rej, bank = local_block(
+                        block_ids, block_live, bank
+                    )
+                    return partials_of(sv, n_k, msums, rej), bank
+
+                partials, new_ef = BK.stream_blocks(
+                    fold_block, ids, live, self._block_size,
+                    banks=ef_bank,
+                )
+            agg_delta = None
+        else:
+            partials, agg_delta, new_ef = self._defended_fold(
+                view, ids, live, rkey, ef_bank, local_block,
+                partials_of,
+            )
+
         new_state = server_update_from_partials(
-            cfg, view, partials, rkey
+            cfg, view, partials, rkey, agg_delta=agg_delta
         )
         if self._peft is not None:
             new_state = self._peft.merge_state(new_state, state)
@@ -906,10 +1016,223 @@ class FedAvgSim:
             "train_acc": fin["acc"],
             "nonfinite_rejected": partials.rejected,
         }
+        if new_ef is not None:
+            return new_state, train_metrics, new_ef
         return new_state, train_metrics
 
+    def _defended_fold(self, view, ids, live, rkey, ef_bank,
+                       local_block, partials_of):
+        """The two-pass streamed-defense body (core/streamdef.py):
+        pass 1 folds ``(RoundPartials, sketch)`` with the EF rows read
+        from the UNCHANGED operand bank (no write — the authoritative
+        roundtrip happens in pass 2, recomputing identical inputs), the
+        defense decision is made from the sketch in-program, pass 2
+        folds the decided aggregate (per-coordinate histogram for the
+        quantile rules; selection-weighted delta sum for the projection
+        rules) and writes the EF bank. Returns ``(partials, agg_delta,
+        new_ef_bank)``."""
+        cfg = self.cfg.fed
+        pipe = robust.DefensePipeline.from_fed(cfg)
+        method = pipe.method
+        quantile = method in SD.QUANTILE_METHODS
+        gp = view.variables["params"]
+
+        def block_deltas(sv):
+            # the defenses see the same per-row preprocessed (clipped)
+            # deltas the stacked reducer sees
+            return pipe.preprocess(jax.tree.map(
+                lambda s, g: s - g[None], sv["params"], gp
+            ))
+
+        def live_votes(block_live, n_k):
+            # quantile rules vote over LIVE rows — a screened client
+            # votes its healed zero delta, matching the stacked
+            # reducer's valid=live membership
+            if block_live is None:
+                return jnp.ones(n_k.shape, jnp.float32)
+            return block_live.astype(jnp.float32)
+
+        def fold_pass1(block_ids, block_live, block_pos):
+            sv, n_k, msums, rej, _ = local_block(
+                block_ids, block_live, ef_bank, write_bank=False
+            )
+            p = partials_of(sv, n_k, msums, rej)
+            deltas = block_deltas(sv)
+            lv = live_votes(block_live, n_k)
+            if quantile:
+                sk = SD.fold_moments(SD.flatten_rows(deltas), lv)
+            else:
+                sk = SD.fold_proj(
+                    deltas, n_k.astype(jnp.float32), lv, block_pos,
+                    self._slots, rkey,
+                )
+            return p, sk
+
+        partials, sketch = BK.stream_blocks(
+            fold_pass1, ids, live, self._block_size, positions=True
+        )
+
+        if quantile:
+            lo, width = SD.hist_edges(sketch)
+
+            def block_hist(sv, n_k, block_live):
+                return SD.fold_hist(
+                    SD.flatten_rows(block_deltas(sv)),
+                    live_votes(block_live, n_k), lo, width,
+                )
+
+            if ef_bank is None:
+                def fold_pass2(block_ids, block_live, block_pos):
+                    sv, n_k, *_unused = local_block(
+                        block_ids, block_live, None
+                    )
+                    return block_hist(sv, n_k, block_live)
+
+                hist = BK.stream_blocks(
+                    fold_pass2, ids, live, self._block_size,
+                    positions=True,
+                )
+                new_ef = None
+            else:
+                def fold_pass2(block_ids, block_live, block_pos, bank):
+                    sv, n_k, _m, _r, bank = local_block(
+                        block_ids, block_live, bank
+                    )
+                    return block_hist(sv, n_k, block_live), bank
+
+                hist, new_ef = BK.stream_blocks(
+                    fold_pass2, ids, live, self._block_size,
+                    banks=ef_bank, positions=True,
+                )
+            if method == "median":
+                est = SD.median_from_hist(
+                    hist, lo, width, sketch.count
+                )
+            else:
+                est = SD.trimmed_mean_from_hist(
+                    hist, lo, width, sketch.count,
+                    SD.trim_table(pipe.trim_frac, self._slots),
+                )
+            return partials, T.tree_unvectorize(est, gp), new_ef
+
+        w, den = SD.selection_weights(
+            method, sketch, pipe.num_adversaries, pipe.multikrum_m
+        )
+
+        def block_wsum(sv, block_pos):
+            return T.tree_weighted_sum(block_deltas(sv), w[block_pos])
+
+        if ef_bank is None:
+            def fold_pass2(block_ids, block_live, block_pos):
+                sv, *_unused = local_block(block_ids, block_live, None)
+                return block_wsum(sv, block_pos)
+
+            wsum = BK.stream_blocks(
+                fold_pass2, ids, live, self._block_size, positions=True
+            )
+            new_ef = None
+        else:
+            def fold_pass2(block_ids, block_live, block_pos, bank):
+                sv, _n, _m, _r, bank = local_block(
+                    block_ids, block_live, bank
+                )
+                return block_wsum(sv, block_pos), bank
+
+            wsum, new_ef = BK.stream_blocks(
+                fold_pass2, ids, live, self._block_size,
+                banks=ef_bank, positions=True,
+            )
+        return partials, T.tree_scale(wsum, 1.0 / den), new_ef
+
+    def _bulk_personal(self, state, view, arrays, ids, live, rkey,
+                       bank):
+        """Personalized PEFT at bulk scale (fedml_tpu.peft.personal ×
+        core/bulk.py): each block gathers its clients' private adapter
+        rows from the :class:`~fedml_tpu.core.statebank.
+        ClientStateBank`, trains with them merged into the shared
+        model, folds the SHARED half into :class:`~fedml_tpu.core.bulk.
+        RoundPartials`, and scatters the trained rows back through the
+        scan carry. The no-leak contract is structural exactly as in
+        :meth:`_personal_round` — the aggregate simply does not contain
+        the private paths — and the non-finite screen covers BOTH
+        halves: a poisoned client contributes nothing to the shared
+        aggregate AND keeps its pre-round bank row."""
+        cfg = self.cfg.fed
+        plan = self._peft
+        base_frozen = plan.private.frozen(state.variables["params"])
+
+        def fold_block(block_ids, block_live, bk):
+            priv = bk.gather(block_ids)
+            ckeys = jax.vmap(lambda c: R.client_key(rkey, c))(block_ids)
+
+            def one(priv_row, idx_row, mask_row, key):
+                params_c = plan.private.merge(priv_row, base_frozen)
+                vars_c = {**state.variables, "params": params_c}
+                out_vars, n_k, msums = self.local_update(
+                    vars_c, idx_row, mask_row, arrays.x, arrays.y, key
+                )
+                trained = out_vars["params"]
+                shared = {
+                    **{k: v for k, v in out_vars.items()
+                       if k != "params"},
+                    "params": plan.private.frozen(trained),
+                }
+                return (shared, plan.private.trainable(trained), n_k,
+                        msums)
+
+            shared, new_priv, n_k, msums = jax.vmap(one)(
+                priv, arrays.idx[block_ids], arrays.mask[block_ids],
+                ckeys,
+            )
+            if block_live is not None:
+                shared, n_k, msums = E.mask_padded(
+                    shared, n_k, msums, view.variables, block_live
+                )
+            # the screen covers BOTH halves; a non-live slot is already
+            # healed and zero-weight, so only live non-finite rows
+            # count as rejections (and only live finite rows write
+            # their bank row)
+            ok = robust.finite_client_mask(
+                {"shared": shared, "private": new_priv}, n_k
+            )
+            lv = (
+                jnp.ones(ok.shape, bool) if block_live is None
+                else block_live
+            )
+            ok = ok | ~lv
+
+            def heal(s, g):
+                m = ok.reshape((-1,) + (1,) * (s.ndim - 1))
+                return jnp.where(m, s, g[None].astype(s.dtype))
+
+            shared = jax.tree.map(heal, shared, view.variables)
+            n_k = jnp.where(ok, n_k, jnp.zeros_like(n_k))
+            rejected = (ok.shape[0] - jnp.sum(ok)).astype(jnp.float32)
+            bk = bk.put(block_ids, new_priv, keep=ok & lv,
+                        gathered=priv)
+            p = fold_block_partials(
+                cfg, self.cfg.train, self.steps_per_epoch,
+                self.batch_size, view, shared, n_k, msums, rejected,
+            )
+            return p, bk
+
+        partials, bank = BK.stream_blocks(
+            fold_block, ids, live, self._block_size, banks=bank
+        )
+        new_view = server_update_from_partials(
+            cfg, view, partials, rkey
+        )
+        new_state = plan.merge_state(new_view, state)
+        fin = finalize_sums(partials.msums)
+        train_metrics = {
+            "train_loss": fin["loss"],
+            "train_acc": fin["acc"],
+            "nonfinite_rejected": partials.rejected,
+        }
+        return new_state, train_metrics, bank
+
     def _personal_round(self, state: ServerState,
-                        arrays: FederatedArrays, bank):
+                        arrays: FederatedArrays, bank, n_active=None):
         """Personalized PEFT round (fedml_tpu.peft.personal,
         docs/PERFORMANCE.md "Parameter-efficient federated
         fine-tuning"): each sampled client trains with ITS OWN private
@@ -918,17 +1241,29 @@ class FedAvgSim:
         scattered back into the bank. The no-leak contract is
         structural: the aggregated view simply does not contain the
         private paths, and the bank scatter writes each row from its
-        own client's update only. Returns ``(state, metrics, bank)``."""
+        own client's update only. ``bank`` is the adapter
+        :class:`~fedml_tpu.core.statebank.ClientStateBank`; with
+        ``n_active`` (elastic buckets) the draw is the full-bucket
+        permutation and non-live slots are healed to zero weight AND
+        keep their pre-round bank rows. Returns ``(state, metrics,
+        bank)``."""
         cfg = self.cfg.fed
         plan = self._peft
         rkey = R.round_key(self.root_key, state.round)
-        cohort = self.sampler(
-            jax.random.fold_in(rkey, 0),
-            arrays.num_clients,
-            cfg.clients_per_round,
-        )
+        if n_active is not None:
+            cohort = self._sample_bucket(
+                jax.random.fold_in(rkey, 0), arrays.num_clients
+            )
+            live = E.active_mask(self._bucket, n_active)
+        else:
+            cohort = self.sampler(
+                jax.random.fold_in(rkey, 0),
+                arrays.num_clients,
+                cfg.clients_per_round,
+            )
+            live = None
         ckeys = jax.vmap(lambda c: R.client_key(rkey, c))(cohort)
-        priv_rows = PP.gather_rows(bank, cohort)
+        priv_rows = bank.gather(cohort)
         base_frozen = plan.private.frozen(state.variables["params"])
 
         def one(priv, idx_row, mask_row, key):
@@ -949,13 +1284,23 @@ class FedAvgSim:
         )
 
         view = plan.view_state(state)
+        if live is not None:
+            # elastic: non-live slots healed to the global shared view
+            # with zero weight before the screen, like the dense path
+            stacked_shared, n_k, msums = E.mask_padded(
+                stacked_shared, n_k, msums, view.variables, live
+            )
         # the non-finite screen covers BOTH halves of a client's
         # update: a poisoned client contributes nothing to the shared
         # aggregate AND keeps its pre-round bank row (the private twin
-        # of the dense path's heal-to-global)
+        # of the dense path's heal-to-global). Non-live slots are
+        # already healed/zero-weight — they are not rejections, and
+        # they keep their pre-round rows too.
         ok = robust.finite_client_mask(
             {"shared": stacked_shared, "private": new_priv}, n_k
         )
+        lv = jnp.ones(ok.shape, bool) if live is None else live
+        ok = ok | ~lv
 
         def heal(s, g):
             m = ok.reshape((-1,) + (1,) * (s.ndim - 1))
@@ -965,17 +1310,17 @@ class FedAvgSim:
             lambda s, g: heal(s, g[None].astype(s.dtype)),
             stacked_shared, view.variables,
         )
-        new_priv = jax.tree.map(heal, new_priv, priv_rows)
         n_k = jnp.where(ok, n_k, jnp.zeros_like(n_k))
         rejected = (ok.shape[0] - jnp.sum(ok)).astype(jnp.float32)
 
         new_view = server_update(
             cfg, self.cfg.train, self.steps_per_epoch,
             self.batch_size, view, stacked_shared, n_k, rkey,
-            local_reducer(),
+            local_reducer(), valid=live,
         )
         new_state = plan.merge_state(new_view, state)
-        new_bank = PP.scatter_rows(bank, cohort, new_priv)
+        new_bank = bank.put(cohort, new_priv, keep=ok & lv,
+                            gathered=priv_rows)
         fin = finalize_sums(jax.tree.map(jnp.sum, msums))
         train_metrics = {
             "train_loss": fin["loss"],
@@ -987,15 +1332,20 @@ class FedAvgSim:
     def _round(self, state: ServerState, arrays: FederatedArrays,
                n_active=None, residual=None, bank=None):
         if self._bulk.enabled():
-            # compression (and so the residual operand) is rejected at
-            # construction in bulk mode — the python-level dispatch
-            # keeps the stacked trace below byte-identical when off
-            return self._bulk_round(state, arrays, n_active)
+            # in bulk mode the residual slot carries the EF
+            # ClientStateBank and the bank slot the adapter bank —
+            # never both (compress+personalize stays rejected); the
+            # python-level dispatch keeps the stacked trace below
+            # byte-identical when bulk is off
+            return self._bulk_round(
+                state, arrays, n_active, ef_bank=residual,
+                adapter_bank=bank,
+            )
         if bank is not None:
             # personalized PEFT: private adapter bank in, bank out
-            # (fedml_tpu.peft.personal; incompatible combos were
-            # rejected at construction, so n_active/residual are None)
-            return self._personal_round(state, arrays, bank)
+            # (fedml_tpu.peft.personal; compress+personalize is
+            # rejected at construction, so residual is None)
+            return self._personal_round(state, arrays, bank, n_active)
         cfg = self.cfg.fed
         stacked_vars, n_k, msums, rkey, cohort = self._locals(
             state, arrays, n_active
@@ -1071,11 +1421,11 @@ class FedAvgSim:
         return new_state, train_metrics
 
     def _fused_block(self, state: ServerState, operand, n_active=None,
-                     residual=None, length: int = 1):
+                     residual=None, bank=None, length: int = 1):
         """``length`` complete rounds as ONE program: a ``lax.scan``
-        over the round body with (state[, EF residual]) as the carry.
-        Each iteration derives its round key from the CARRIED
-        ``state.round`` (``_locals`` folds it in), so sampling,
+        over the round body with (state[, EF residual / adapter bank])
+        as the carry. Each iteration derives its round key from the
+        CARRIED ``state.round`` (``_locals`` folds it in), so sampling,
         adversary injection, and the compression quantizer draws are
         bitwise-identical to ``length`` separate ``_round`` calls —
         only XLA's cross-iteration fusion may reassociate float sums
@@ -1094,6 +1444,18 @@ class FedAvgSim:
                 body, (state, residual), None, length=length
             )
             return state, ms, residual
+        if bank is not None:
+            def body(carry, _):
+                s, bk = carry
+                s, m, bk = self._round_impl(
+                    s, operand, n_active, None, bk
+                )
+                return (s, bk), m
+
+            (state, bank), ms = jax.lax.scan(
+                body, (state, bank), None, length=length
+            )
+            return state, ms, bank
 
         def body(carry, _):
             s, m = self._round_impl(carry, operand, n_active)
@@ -1120,35 +1482,49 @@ class FedAvgSim:
                 "run_block requires FedConfig(fuse_rounds > 1) — the "
                 "fused block program is built at construction"
             )
+        bulk = self._bulk.enabled()
         compressed = self._cspec.enabled()
-        if compressed and self._ef_residual is None:
-            self._ef_residual = C.zero_residual(
-                self._wire_template(state.variables), self._bucket
-            )
-            telemetry.METRICS.gauge(
-                "compress.ratio",
-                C.wire_ratio(self._cspec,
-                             self._wire_template(state.variables)),
-            )
+        personalized = (
+            self._peft is not None and self._peft.personalized
+        )
+        if personalized:
+            self._ensure_adapter_bank(state)
+        if compressed:
+            if bulk:
+                self._ensure_ef_bank(state)
+            elif self._ef_residual is None:
+                self._ef_residual = C.zero_residual(
+                    self._wire_template(state.variables), self._bucket
+                )
+                telemetry.METRICS.gauge(
+                    "compress.ratio",
+                    C.wire_ratio(self._cspec,
+                                 self._wire_template(state.variables)),
+                )
         operand = self._round_operand()
         n = (
             jnp.asarray(self._n_active, jnp.int32)
             if self._elastic else None
         )
-        if self._bulk.enabled():
+        if bulk:
             # nested scans: the outer fused-round scan wraps the inner
             # block scan (the bulk round IS _round_impl's body here);
             # the fused block counts its K rounds so bulk.rounds stays
             # per-round like every fused metric
             self._note_bulk_dispatch(rounds=length)
+            if self._stream_defense is not None:
+                self._note_stream_defense(state)
             key = self._program_key() + (length,)
         else:
             key = (self._bucket, length)
+        res = None
+        if compressed:
+            res = self._ef_bank if bulk else self._ef_residual
 
         def call():
             return self._block_fn(
-                key, state, operand, n,
-                self._ef_residual if compressed else None, length,
+                key, state, operand, n, res,
+                self._bank_adapter if personalized else None, length,
             )
 
         out = (
@@ -1156,7 +1532,23 @@ class FedAvgSim:
             if self._elastic else call()
         )
         if compressed:
-            state, m, self._ef_residual = out
+            state, m, new_res = out
+            if bulk:
+                self._ef_bank = new_res
+                SB.note_round_io(
+                    length * self._n_blocks
+                    * (2 if self._stream_defense else 1),
+                    length * self._n_blocks,
+                )
+            else:
+                self._ef_residual = new_res
+            return state, m
+        if personalized:
+            state, m, self._bank_adapter = out
+            SB.note_round_io(
+                length * (self._n_blocks if bulk else 1),
+                length * (self._n_blocks if bulk else 1),
+            )
             return state, m
         return out
 
@@ -1171,6 +1563,105 @@ class FedAvgSim:
             self._block_size, self._n_blocks,
             self._slots - self._n_active, rounds=rounds,
         )
+
+    def _note_stream_defense(self, state: ServerState) -> None:
+        """``defense.sketch_*`` gauges at bulk dispatch
+        (docs/OBSERVABILITY.md) — one attribute check when off."""
+        if not telemetry.METRICS.enabled:
+            return
+        variables = (
+            state.variables if self._peft is None
+            else self._peft.view_state(state).variables
+        )
+        flat_dim = sum(
+            int(v.size) for v in jax.tree.leaves(variables["params"])
+        )
+        SD.note_defense(self._stream_defense, flat_dim, self._slots)
+
+    # -- client-state banks (core/statebank.py) ----------------------------
+    @property
+    def _adapter_bank(self):
+        """Raw ``[num_clients, ...]`` adapter rows (None before the
+        first personalized round) — the established surface
+        :func:`fedml_tpu.peft.personal.personal_variables` and the
+        personalization tests consume; internally the rows live in a
+        :class:`~fedml_tpu.core.statebank.ClientStateBank`."""
+        b = self._bank_adapter
+        return None if b is None else b.rows
+
+    @_adapter_bank.setter
+    def _adapter_bank(self, rows):
+        self._bank_adapter = (
+            None if rows is None
+            else SB.ClientStateBank("adapter", rows)
+        )
+
+    def _ensure_adapter_bank(self, state: ServerState) -> None:
+        """Create the personalization bank LAZILY on the first round
+        (from the CURRENT state's init-valued adapters) so that the
+        repo's re-call-init()-for-a-snapshot idiom can never reset a
+        trained bank mid-run; its lifetime is the simulator's."""
+        if self._bank_adapter is not None:
+            return
+        rows = PP.init_bank(
+            self._peft, state.variables["params"],
+            self.arrays.num_clients,
+        )
+        self._bank_adapter = SB.ClientStateBank("adapter", rows)
+        telemetry.METRICS.gauge(
+            "peft.personal_bank_mb", PP.bank_bytes(rows) / 1e6
+        )
+        SB.note_bank(self._bank_adapter)
+
+    def _ensure_ef_bank(self, state: ServerState) -> None:
+        """Create the bulk-mode error-feedback bank lazily: one zero
+        row per CLIENT of the wire template (round 0 transmits the
+        uncorrected delta, exactly like the stacked zero carry)."""
+        if self._ef_bank is not None:
+            return
+        self._ef_bank = SB.ClientStateBank.zeros(
+            "ef_residual", self._wire_template(state.variables),
+            self.arrays.num_clients,
+        )
+        telemetry.METRICS.gauge(
+            "compress.ratio",
+            C.wire_ratio(self._cspec,
+                         self._wire_template(state.variables)),
+        )
+        SB.note_bank(self._ef_bank)
+
+    def bank_state(self) -> dict:
+        """Client-state banks for the checkpoint composite
+        (docs/FAULT_TOLERANCE.md "Client-state banks"): ``{name:
+        savable rows}``, empty when no bank has been created yet (a
+        fresh run has nothing to save — and nothing to restore)."""
+        out = {}
+        if self._bank_adapter is not None:
+            out[self._bank_adapter.name] = self._bank_adapter.savable()
+        if self._ef_bank is not None:
+            out[self._ef_bank.name] = self._ef_bank.savable()
+        return out
+
+    def restore_banks(self, state: ServerState, blob) -> None:
+        """Adopt checkpointed bank rows (the restore half of
+        :meth:`bank_state`). A None/empty or legacy blob — or a blob
+        from a run without this bank — leaves the lazy fresh-bank init
+        in place instead of crashing: the run resumes with round-0
+        rows, which is exactly what a pre-bank checkpoint encoded."""
+        if not blob:
+            return
+        if ("adapter" in blob and self._peft is not None
+                and self._peft.personalized):
+            self._ensure_adapter_bank(state)
+            self._bank_adapter = SB.ClientStateBank.from_savable(
+                "adapter", self._bank_adapter.rows, blob["adapter"]
+            )
+        if ("ef_residual" in blob and self._bulk.enabled()
+                and self._cspec.enabled()):
+            self._ensure_ef_bank(state)
+            self._ef_bank = SB.ClientStateBank.from_savable(
+                "ef_residual", self._ef_bank.rows, blob["ef_residual"]
+            )
 
     def _wire_template(self, variables):
         """What one client's update payload looks like on the wire:
@@ -1196,34 +1687,72 @@ class FedAvgSim:
     def run_round(self, state: ServerState):
         if self._bulk.enabled():
             self._note_bulk_dispatch()
+            if self._stream_defense is not None:
+                self._note_stream_defense(state)
             key = self._program_key()
+            n = (
+                jnp.asarray(self._n_active, jnp.int32)
+                if self._elastic else None
+            )
+            if self._peft is not None and self._peft.personalized:
+                self._ensure_adapter_bank(state)
+
+                def call():
+                    return self._round_fn(
+                        key, state, self.arrays, n, None,
+                        self._bank_adapter,
+                    )
+
+                state, m, self._bank_adapter = (
+                    E.mirror_jit_cache(self._round_fn, call)
+                    if self._elastic else call()
+                )
+                SB.note_round_io(self._n_blocks, self._n_blocks)
+                return state, m
+            if self._cspec.enabled():
+                self._ensure_ef_bank(state)
+
+                def call():
+                    return self._round_fn(
+                        key, state, self.arrays, n, self._ef_bank
+                    )
+
+                state, m, self._ef_bank = (
+                    E.mirror_jit_cache(self._round_fn, call)
+                    if self._elastic else call()
+                )
+                SB.note_round_io(
+                    self._n_blocks
+                    * (2 if self._stream_defense else 1),
+                    self._n_blocks,
+                )
+                return state, m
             if not self._elastic:
                 return self._round_fn(key, state, self.arrays)
-            n = jnp.asarray(self._n_active, jnp.int32)
             return E.mirror_jit_cache(
                 self._round_fn,
                 lambda: self._round_fn(key, state, self.arrays, n),
             )
         if self._peft is not None and self._peft.personalized:
             # the bank is a donated operand and comes back updated —
-            # the same thread-through discipline as the EF residual.
-            # Created LAZILY on the first round (from the CURRENT
-            # state's init-valued adapters) so that the repo's
-            # re-call-init()-for-a-snapshot idiom can never reset a
-            # trained bank mid-run; its lifetime is the simulator's.
-            if self._adapter_bank is None:
-                self._adapter_bank = PP.init_bank(
-                    self._peft, state.variables["params"],
-                    self.arrays.num_clients,
-                )
-                telemetry.METRICS.gauge(
-                    "peft.personal_bank_mb",
-                    PP.bank_bytes(self._adapter_bank) / 1e6,
-                )
-            state, m, self._adapter_bank = self._round_fn(
-                self._bucket, state, self.arrays, None, None,
-                self._adapter_bank,
+            # the same thread-through discipline as the EF residual
+            self._ensure_adapter_bank(state)
+            n = (
+                jnp.asarray(self._n_active, jnp.int32)
+                if self._elastic else None
             )
+
+            def call():
+                return self._round_fn(
+                    self._bucket, state, self.arrays, n, None,
+                    self._bank_adapter,
+                )
+
+            state, m, self._bank_adapter = (
+                E.mirror_jit_cache(self._round_fn, call)
+                if self._elastic else call()
+            )
+            SB.note_round_io(1, 1)
             return state, m
         compressed = self._cspec.enabled()
         if compressed and self._ef_residual is None:

@@ -178,13 +178,15 @@ class FedConfig:
     # cohort through the device in fixed-size blocks of B clients —
     # each block runs the vmapped local update and is immediately
     # folded into an O(model) partial-sum lax.scan carry, so peak
-    # round memory is O(B + model) instead of O(cohort). mean/FedNova
-    # reduce rules only (selection defenses need the full [C, D] stack
-    # and are rejected at construction); composes with elastic_buckets
-    # (buckets apply to the block COUNT) and fuse_rounds (nested
-    # scans); incompatible with compress (the error-feedback residual
-    # is itself an O(C) buffer). 0 (default) keeps the stacked
-    # [C, ...] round byte-identical.
+    # round memory is O(B + model) instead of O(cohort). Composes with
+    # elastic_buckets (buckets apply to the block COUNT), fuse_rounds
+    # (nested scans), compress (the error-feedback residual lives in a
+    # client-id-keyed ClientStateBank, core/statebank.py, threaded
+    # through the block scan carry), peft_personalize (the adapter
+    # bank streams the same way), every robust_method (block-folded
+    # defense sketches, core/streamdef.py), and every adversary mode
+    # (per-row (round, client-id)-keyed draws). 0 (default) keeps the
+    # stacked [C, ...] round byte-identical.
     client_block_size: int = 0
     # fused multi-round execution (core/fuse.py, docs/PERFORMANCE.md
     # "Round fusion"): run K complete rounds as ONE compiled program —
@@ -241,8 +243,10 @@ class FedConfig:
     # personalization (fedml_tpu.peft.personal): keep each client's
     # adapters in a PRIVATE per-client bank — only the shared head
     # aggregates, and client i's adapters never reach the server or
-    # client j. Plain per-round FedAvgSim path only (bulk/elastic/
-    # compress/fuse/sharded/adversary combos are rejected loudly).
+    # client j. The bank is a client-state bank (core/statebank.py):
+    # it rides bulk streaming, elastic buckets, fuse_rounds, the
+    # mesh-sharded runtime, and checkpoint_every; compress / defended
+    # robust_method / adversary combos are rejected loudly.
     peft_personalize: bool = False
 
 

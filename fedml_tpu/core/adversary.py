@@ -191,12 +191,22 @@ def _attack_one(policy: AdversaryPolicy, delta: Pytree, key: jax.Array,
 
 
 def corrupt_stacked_deltas(policy: AdversaryPolicy, stacked: Pytree,
-                           round_idx) -> Pytree:
+                           round_idx,
+                           cohort: jax.Array | None = None) -> Pytree:
     """Simulator path: return the ATTACKED version of every row of a
     stacked ``[C, ...]`` delta pytree (one vectorized op — the caller
     selects adversarial rows with its cohort mask, so honest rows stay
     byte-identical to the untouched input). Jit-traceable; ``round_idx``
-    may be a traced scalar."""
+    may be a traced scalar.
+
+    ``cohort`` (``[C]`` sampled client ids) keys the ``gauss`` draw per
+    ROW on (round, client id) instead of one full-stack-shaped draw —
+    the draw is then independent of how the cohort is chunked, so the
+    bulk engine's per-block application is bitwise-equal to the stacked
+    path at matched seeds (pinned in ``tests/test_streamdef.py``). The
+    stacked simulator passes its cohort too, so both paths share one
+    keying. Every other mode is row-local (or, for collude, depends
+    only on (seed, round)) and ignores ``cohort``."""
     if policy.mode == "collude":
         # one shared pseudo-delta, broadcast over the cohort axis
         like = jax.tree.map(lambda x: x[0], stacked)
@@ -205,9 +215,14 @@ def corrupt_stacked_deltas(policy: AdversaryPolicy, stacked: Pytree,
             lambda x, b: jnp.broadcast_to(b[None], x.shape), stacked,
             base,
         )
-    return _attack_one(
-        policy, stacked, _round_key(policy, round_idx), round_idx
-    )
+    rk = _round_key(policy, round_idx)
+    if policy.mode == "gauss" and cohort is not None:
+        return jax.vmap(
+            lambda d, c: _attack_one(
+                policy, d, jax.random.fold_in(rk, c), round_idx
+            )
+        )(stacked, cohort)
+    return _attack_one(policy, stacked, rk, round_idx)
 
 
 def cohort_mask(policy: AdversaryPolicy, cohort: jax.Array,

@@ -32,18 +32,25 @@ padding tiers:
   band (blockwise sums then a combine, vs one reduction over C; the
   same equality class as bucket padding / sharded psum, pinned in
   ``tests/test_bulk.py``).
-- **Rejected rules**: selection/gather defenses (``median`` /
-  ``trimmed_mean`` / ``krum`` / ``multikrum`` / ``fltrust``) score the
-  full ``[C, D]`` stacked-delta matrix, which the streaming reduce
-  never materializes. They are rejected LOUDLY at construction
-  (:func:`check_bulk_compat`), never silently approximated.
-- **Rejected composition**: wire compression's error-feedback residual
-  is a dense ``[cohort, ...]`` carry — itself the O(C) buffer the
-  block scan exists to eliminate — so ``compress + bulk`` is rejected
-  at construction (a sharded/host-resident residual bank is the future
-  fix; rejection is the honest present). The ``gauss`` adversary mode
-  draws its noise over the full stacked shape and would repeat the
-  draw per block; every other adversary mode is per-row and composes.
+- **Streamed rules**: the selection/gather defenses (``median`` /
+  ``trimmed_mean`` / ``krum`` / ``multikrum`` / ``fltrust``) run as
+  TWO-PASS streaming computations over this same block scan
+  (:mod:`fedml_tpu.core.streamdef`): pass 1 folds an O(sketch) summary
+  (coordinate moments, or seeded random projections), the selection is
+  decided from the sketch, pass 2 folds the decided aggregate — the
+  full ``[C, D]`` stacked-delta matrix is never materialized, and the
+  accuracy contract of each sketch is stated honestly in streamdef's
+  module doc (and pinned in ``tests/test_streamdef.py``).
+- **Banked composition**: per-client O(C) states — the wire codec's
+  error-feedback residual, the PEFT private adapter bank — live in a
+  :class:`~fedml_tpu.core.statebank.ClientStateBank` keyed by CLIENT
+  ID: each block gathers its sampled rows, updates them, and scatters
+  them back through the scan carry (``stream_blocks(banks=...)``), so
+  ``compress + bulk`` and ``personalize + bulk`` compose at O(block)
+  round memory. The ``gauss`` adversary draws per-row noise keyed on
+  (round, client id) (:func:`fedml_tpu.core.adversary.
+  corrupt_stacked_deltas`), so it composes with the block scan too —
+  bitwise-equal to the stacked path at matched seeds.
 
 Elasticity applies to the block COUNT: the scan length is the
 power-of-two bucket of ``ceil(C / B)`` blocks, the live cohort count
@@ -102,40 +109,25 @@ class BulkSpec:
 
 
 def check_bulk_compat(fed, adversary=None) -> None:
-    """Reject configurations the streaming partial-sum reduce cannot
-    express EXACTLY — raised at construction (and at run.py parse
-    time), never silently approximated mid-run."""
-    method = getattr(fed, "robust_method", "mean") or "mean"
-    if method not in BULK_REDUCE_RULES:
-        raise ValueError(
-            f"robust_method={method!r} is incompatible with bulk "
-            "(client_block_size) execution: selection/gather defenses "
-            "(median/trimmed_mean/krum/multikrum/fltrust) score the "
-            "full [C, D] stacked-delta matrix, which the O(block) "
-            "streaming reduce never materializes. Run the defended "
-            "cohort on the stacked path (client_block_size=0); "
-            "robust_norm_clip and robust_noise_stddev DO compose "
-            "(per-row clip, aggregate noise)."
-        )
-    if getattr(fed, "compress", "none") not in ("none", "", None):
-        raise ValueError(
-            "compress is incompatible with bulk (client_block_size) "
-            "execution: the error-feedback residual is a dense "
-            "[cohort, ...] carry — exactly the O(C) buffer the block "
-            "scan exists to eliminate (core/bulk.py). Use the stacked "
-            "path (client_block_size=0) for compressed experiments."
-        )
-    if adversary is not None and adversary.enabled() \
-            and adversary.mode == "gauss":
-        raise ValueError(
-            "adversary mode 'gauss' is incompatible with bulk "
-            "(client_block_size) execution: its noise is drawn over "
-            "the full stacked [C, ...] shape, so a per-block "
-            "application would repeat the same draw every block. Use "
-            "the stacked path, or a per-row mode (sign_flip/"
-            "scale_boost/zero/constant/collude — all compose with "
-            "bulk)."
-        )
+    """Validate a bulk configuration at construction (and at run.py
+    parse time). The PR 14 composition walls have all fallen:
+
+    - selection defenses stream through the two-pass sketches of
+      :mod:`fedml_tpu.core.streamdef` (every
+      :attr:`~fedml_tpu.core.robust.DefensePipeline.METHODS` rule);
+    - ``compress`` keeps its error-feedback residual in a client-id-
+      keyed :class:`~fedml_tpu.core.statebank.ClientStateBank` that
+      rides the block scan carry;
+    - the ``gauss`` adversary draws per-row noise keyed on (round,
+      client id), bitwise-equal to the stacked path at matched seeds.
+
+    The method name itself is validated by
+    :class:`~fedml_tpu.core.robust.DefensePipeline`; what remains here
+    is the fednova×defense wall (owned by
+    :func:`~fedml_tpu.core.robust.check_fednova_compat`), enforced by
+    the callers. The function stays as the single parse-time/
+    construction seam so a future wall fails loudly in one place."""
+    del fed, adversary  # everything composes — see docstring
 
 
 def plan_blocks(cohort: int, block_size: int, elastic: bool) -> int:
@@ -176,6 +168,8 @@ def stream_blocks(
     ids: jax.Array,
     live: jax.Array | None,
     block_size: int,
+    banks: Pytree | None = None,
+    positions: bool = False,
 ) -> Pytree:
     """Fold ``ids`` (``[S]`` client ids, ``S`` a multiple of
     ``block_size``) through ``fold_block(block_ids[, block_live])`` in
@@ -184,7 +178,20 @@ def stream_blocks(
     bool or None = all live) rides the scan as a per-block operand so a
     traced live count never retraces the program. A single-block cohort
     skips the scan entirely (no loop-carry layout copies for the
-    B >= C case)."""
+    B >= C case).
+
+    ``positions=True`` additionally passes each block's global slot
+    indices (``block_pos``, the block's slice of ``arange(S)``) — the
+    streaming defenses scatter per-slot sketch rows by position
+    (:mod:`fedml_tpu.core.streamdef`).
+
+    ``banks`` (a pytree — typically one or more
+    :class:`~fedml_tpu.core.statebank.ClientStateBank`) threads
+    client-keyed state through the scan carry with REPLACE semantics:
+    ``fold_block`` takes the banks as its last argument, returns
+    ``(partials, banks)``, and the partials sum while the banks flow
+    through updated in place (gather/scatter per block, donation-
+    friendly). The call then returns ``(partials, banks)``."""
     n_slots = ids.shape[0]
     if n_slots % block_size != 0:
         raise ValueError(
@@ -193,23 +200,49 @@ def stream_blocks(
         )
     nb = n_slots // block_size
     ids_b = ids.reshape(nb, block_size)
+    xs = [ids_b]
     if live is None:
-        fold = lambda bids, _unused: fold_block(bids, None)
-        xs = (ids_b, jnp.zeros((nb,), jnp.int32))
+        xs.append(jnp.zeros((nb,), jnp.int32))  # placeholder operand
     else:
-        fold = fold_block
-        xs = (ids_b, live.reshape(nb, block_size))
+        xs.append(live.reshape(nb, block_size))
+    if positions:
+        xs.append(
+            jnp.arange(n_slots, dtype=jnp.int32).reshape(nb, block_size)
+        )
+    xs = tuple(xs)
+
+    def call(x, bk):
+        args = list(x)
+        if live is None:
+            args[1] = None
+        if banks is None:
+            return fold_block(*args)
+        return fold_block(*args, bk)
+
+    x0 = jax.tree.map(lambda a: a[0], xs)
     if nb == 1:
-        return fold(*jax.tree.map(lambda a: a[0], xs))
-    shapes = jax.eval_shape(fold, *jax.tree.map(lambda a: a[0], xs))
-    zero = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+        return call(x0, banks)
+    if banks is None:
+        shapes = jax.eval_shape(lambda x: call(x, None), x0)
+        zero = jax.tree.map(
+            lambda s: jnp.zeros(s.shape, s.dtype), shapes
+        )
 
-    def body(carry, x):
-        p = fold(*x)
-        return jax.tree.map(jnp.add, carry, p), None
+        def body(carry, x):
+            return jax.tree.map(jnp.add, carry, call(x, None)), None
 
-    out, _ = jax.lax.scan(body, zero, xs)
-    return out
+        out, _ = jax.lax.scan(body, zero, xs)
+        return out
+    p_shapes, _ = jax.eval_shape(call, x0, banks)
+    zero = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), p_shapes)
+
+    def body_banked(carry, x):
+        psum, bk = carry
+        p, bk = call(x, bk)
+        return (jax.tree.map(jnp.add, psum, p), bk), None
+
+    (out, banks), _ = jax.lax.scan(body_banked, (zero, banks), xs)
+    return out, banks
 
 
 def note_round(block_size: int, n_blocks: int, padded_slots: int,

@@ -312,6 +312,29 @@ def roundtrip_stacked(
     return jax.vmap(one)(stacked_delta, residual, keys)
 
 
+def roundtrip_rows(
+    spec: CompressionSpec, stacked_delta: Pytree, residual_rows: Pytree,
+    rkey: jax.Array, ids: jax.Array,
+) -> tuple[Pytree, Pytree]:
+    """:func:`roundtrip_stacked` with the quantizer keyed by CLIENT ID
+    instead of cohort slot — the bulk engine's form, where the
+    error-feedback residual lives in a client-id-keyed
+    :class:`~fedml_tpu.core.statebank.ClientStateBank` and each block's
+    gathered rows roundtrip against their own ids. The keying (and so
+    the stochastic rounding stream) deliberately differs from the
+    stacked path's slot keying: a client's quantizer noise follows the
+    client across rounds, not the slot it happened to land in —
+    trajectories are compared by convergence/telescoping pins, not
+    bitwise (``tests/test_statebank.py``)."""
+    keys = jax.vmap(lambda i: slot_key(spec, rkey, i))(ids)
+
+    def one(delta, res, key):
+        _, deq, new_res = apply_with_feedback(spec, delta, res, key)
+        return deq, new_res
+
+    return jax.vmap(one)(stacked_delta, residual_rows, keys)
+
+
 def decompress_stacked(spec: CompressionSpec, stacked_payload: Pytree,
                        template: Pytree) -> Pytree:
     """Server side: stacked payload tree (leaves ``[C, ...]``) ->

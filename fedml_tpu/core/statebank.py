@@ -1,0 +1,199 @@
+"""Per-client state banks: ONE ``[num_clients, row]`` store for every
+O(C) client-keyed state the compiled rounds carry.
+
+The bulk engine (core/bulk.py) streams a cohort through the device in
+O(block) memory — which is exactly why any per-client state (the
+compress error-feedback residual, the PEFT private adapter bank) could
+not ride it: both are ``[C, ...]`` buffers keyed by client identity,
+and the streaming reduce folds identity away. A
+:class:`ClientStateBank` restores the seam:
+
+- the bank is a host- or device-resident pytree whose every leaf has a
+  leading ``num_clients`` axis (the "rows");
+- each round (or each block of a bulk round) GATHERS the sampled ids'
+  rows, updates them, and SCATTERS them back — the bank itself rides
+  the round program as a donated operand (and the ``lax.scan`` carry of
+  :func:`fedml_tpu.core.bulk.stream_blocks`), so round working memory
+  stays O(block) while the bank is updated in place;
+- **sentinel padding**: a padded slot carries the out-of-range id
+  ``num_clients``. JAX clamps out-of-bounds *gathers* (the garbage row
+  is masked by the live mask downstream) and ``mode="drop"`` discards
+  out-of-bounds *scatters* — so a pad slot can never collide with a
+  real client id the way a 0-filled pad would collide with client 0
+  (``.at[ids].set`` leaves duplicate-index write order unspecified);
+- **screening preserves rows**: :meth:`ClientStateBank.put` takes a
+  ``keep`` mask — a row is written only where ``keep`` holds, and a
+  screened (non-finite) or non-live slot writes its GATHERED pre-round
+  row back, a value-level no-op (ids are a without-replacement draw,
+  so no real id appears twice in a round);
+- the bank rides the :class:`~fedml_tpu.utils.checkpoint
+  .RoundCheckpointer` composite (``{"server": ..., "bank": {name:
+  rows}}``) so a SIGKILLed run restores every client's row bitwise
+  (docs/FAULT_TOLERANCE.md "Client-state banks").
+
+Registered as a pytree (``name`` is static aux data), so a bank passes
+through ``jax.jit`` operands, donation, and scan carries unchanged.
+
+Telemetry (docs/OBSERVABILITY.md): ``bank.rows`` / ``bank.row_bytes``
+/ ``bank.resident_mb`` gauges at bank creation, ``bank.gathers`` /
+``bank.scatters`` counters at each round dispatch that touches a bank.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.core import telemetry
+
+Pytree = Any
+
+
+@jax.tree_util.register_pytree_node_class
+class ClientStateBank:
+    """A named ``[num_clients, ...]``-leaved pytree of per-client rows."""
+
+    def __init__(self, name: str, rows: Pytree):
+        self.name = name
+        self.rows = rows
+
+    def tree_flatten(self):
+        return (self.rows,), self.name
+
+    @classmethod
+    def tree_unflatten(cls, name, children):
+        return cls(name, children[0])
+
+    # -- constructors -------------------------------------------------------
+
+    @classmethod
+    def zeros(cls, name: str, template: Pytree,
+              num_clients: int) -> "ClientStateBank":
+        """Every row a zero of ``template``'s leaf shapes (the EF
+        residual's init: round 0 transmits the uncorrected delta)."""
+        rows = jax.tree.map(
+            lambda v: jnp.zeros((num_clients,) + tuple(v.shape), v.dtype),
+            template,
+        )
+        return cls(name, rows)
+
+    @classmethod
+    def broadcast(cls, name: str, template: Pytree,
+                  num_clients: int) -> "ClientStateBank":
+        """Every row a copy of ``template`` (the adapter bank's init:
+        round 0 every client IS the base model)."""
+        rows = jax.tree.map(
+            lambda v: jnp.broadcast_to(
+                v[None], (num_clients,) + tuple(v.shape)
+            ).astype(v.dtype),
+            template,
+        )
+        return cls(name, rows)
+
+    # -- geometry -----------------------------------------------------------
+
+    @property
+    def num_rows(self) -> int:
+        leaves = jax.tree.leaves(self.rows)
+        return int(leaves[0].shape[0]) if leaves else 0
+
+    @property
+    def sentinel(self) -> int:
+        """The pad id: out of range by construction, see module doc."""
+        return self.num_rows
+
+    def row_bytes(self) -> int:
+        return sum(
+            int(jnp.dtype(v.dtype).itemsize)
+            * int(max(1, v.size) // max(1, v.shape[0]))
+            for v in jax.tree.leaves(self.rows)
+        )
+
+    def resident_bytes(self) -> int:
+        return sum(
+            int(jnp.dtype(v.dtype).itemsize) * int(v.size)
+            for v in jax.tree.leaves(self.rows)
+        )
+
+    # -- gather / scatter ---------------------------------------------------
+
+    def gather(self, ids: jax.Array) -> Pytree:
+        """The sampled ids' rows, stacked ``[B, ...]``. Sentinel ids
+        clamp (JAX out-of-bounds gather) to the last real row — callers
+        mask pad slots with the live mask before the rows matter."""
+        return jax.tree.map(lambda v: v[ids], self.rows)
+
+    def put(self, ids: jax.Array, new_rows: Pytree, keep=None,
+            gathered: Pytree | None = None) -> "ClientStateBank":
+        """Scatter updated rows back by id. Sentinel (out-of-range) ids
+        are DROPPED; where ``keep`` (a ``[B]`` bool mask — live and
+        finite) is False the pre-round row is written back unchanged (a
+        value-level no-op). ``gathered`` skips the re-gather when the
+        caller already holds the pre-round rows."""
+        if keep is not None:
+            if gathered is None:
+                gathered = self.gather(ids)
+            new_rows = jax.tree.map(
+                lambda n, o: jnp.where(
+                    keep.reshape((-1,) + (1,) * (n.ndim - 1)), n,
+                    o.astype(n.dtype),
+                ),
+                new_rows, gathered,
+            )
+        rows = jax.tree.map(
+            lambda b, r: b.at[ids].set(r.astype(b.dtype), mode="drop"),
+            self.rows, new_rows,
+        )
+        return ClientStateBank(self.name, rows)
+
+    # -- checkpoint ride-along ----------------------------------------------
+
+    def savable(self) -> Pytree:
+        return self.rows
+
+    @classmethod
+    def from_savable(cls, name: str, template_rows: Pytree,
+                     blob: Pytree) -> "ClientStateBank":
+        from fedml_tpu.utils import checkpoint as CK
+
+        return cls(name, CK.from_savable(template_rows, blob))
+
+
+def pad_ids(ids: jax.Array, n_slots: int, sentinel: int) -> jax.Array:
+    """Pad a ``[draw]`` id vector to ``[n_slots]`` with the sentinel
+    (out-of-range) id — see the module doc for why not 0."""
+    pad = n_slots - int(ids.shape[0])
+    if pad <= 0:
+        return ids
+    fill = jnp.full((pad,), sentinel, ids.dtype)
+    return jnp.concatenate([ids, fill])
+
+
+# ---------------------------------------------------------------------------
+# telemetry (names are docs/OBSERVABILITY.md vocabulary rows)
+# ---------------------------------------------------------------------------
+
+
+def note_bank(bank: ClientStateBank) -> None:
+    """Resident-footprint gauges, written once at bank creation (and
+    harmless to refresh)."""
+    m = telemetry.METRICS
+    if not m.enabled:
+        return
+    m.gauge("bank.rows", float(bank.num_rows))
+    m.gauge("bank.row_bytes", float(bank.row_bytes()))
+    m.gauge("bank.resident_mb", bank.resident_bytes() / 1e6)
+
+
+def note_round_io(gathers: int, scatters: int) -> None:
+    """Per-dispatch gather/scatter counts (host-side; one per block per
+    bank in a bulk round, one per round on the stacked path)."""
+    m = telemetry.METRICS
+    if not m.enabled:
+        return
+    if gathers:
+        m.inc("bank.gathers", gathers)
+    if scatters:
+        m.inc("bank.scatters", scatters)

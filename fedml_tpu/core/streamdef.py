@@ -1,0 +1,360 @@
+"""Streaming Byzantine defenses: selection/quantile rules for the bulk
+engine that never materialize the ``[C, D]`` stacked-delta matrix.
+
+The stacked defenses (core/robust.py) are exact but need the whole
+cohort's deltas resident at once — precisely the O(C·model) buffer the
+bulk engine (core/bulk.py) exists to avoid. This module re-expresses
+each rule as a TWO-PASS streaming computation over the same block scan
+that folds :class:`~fedml_tpu.core.bulk.RoundPartials`: pass 1 folds a
+low-dimensional SKETCH of the cohort, the defense decision is made
+in-program from the sketch, and pass 2 folds the decided aggregate —
+both passes recompute the deterministic local updates (the recompute
+idiom; the 2x step compute IS the measured
+``defense_stream_overhead_ms``), so round working memory stays
+O(block + sketch).
+
+Two sketch families, with HONEST accuracy contracts (pinned in
+``tests/test_streamdef.py``, documented in docs/PERFORMANCE.md):
+
+- **coordinate-quantile sketch** (``median`` / ``trimmed_mean``):
+  pass 1 folds exact per-coordinate moments (sum, sum-of-squares,
+  valid count — additive across blocks); pass 2 folds a per-coordinate
+  histogram of ``HIST_BINS`` bins spanning ``mu ± HIST_SPAN·sd``; the
+  quantile is interpolated from the histogram CDF. Sketch memory is
+  O(HIST_BINS · D), independent of the cohort; the estimate is within
+  ONE BIN WIDTH (``2·HIST_SPAN·sd / HIST_BINS`` per coordinate) of the
+  stacked order statistic, degrading to exact when a coordinate's
+  spread is zero.
+- **random-projection sketch** (``krum`` / ``multikrum`` /
+  ``fltrust``): pass 1 folds each client's seeded random projection
+  (``[slots, PROJ_DIM]``, the Johnson–Lindenstrauss sketch — the
+  projection matrix is regenerated per block from the round key, so it
+  never persists), its TRUE delta norm, and its weight; selection runs
+  the PR 7 ``pairwise_sq_dists_rows`` row-blocked-gram idiom on the
+  projected rows; pass 2 folds the selected/trust-weighted sum of the
+  true full-D deltas. Krum/multi-Krum reproduce the stacked selection
+  whenever the projected distance ordering preserves the decision
+  margin (near-certain for the large separations an actual attack
+  produces; a coin-flip near ties) — and GIVEN the same selection the
+  aggregate matches the stacked rule to f32 accumulation order.
+  FLTrust's reference is the coordinate-median of the PROJECTED rows
+  and its norm-match target is the median cohort norm (the stacked
+  rule norm-matches to the full-D median delta's norm); when total
+  trust is zero the streamed rule degrades to a ZERO aggregate where
+  the stacked rule returns the reference delta itself — there is no
+  full-D reference to return at O(sketch) memory.
+
+The sketches fold through the same ``lax.scan`` carry as
+``RoundPartials``; eligibility semantics match the stacked reducer
+exactly (quantile rules vote over LIVE rows — a screened client votes
+its healed zero delta, as ``server_update`` passes ``valid=live``;
+selection rules require ``live & weight > 0``).
+
+Telemetry (docs/OBSERVABILITY.md): ``defense.sketch_bins``,
+``defense.sketch_proj_dim``, ``defense.sketch_mb`` gauges at bulk
+dispatch.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.core import robust
+from fedml_tpu.core import telemetry
+from fedml_tpu.core import tree as T
+
+Pytree = Any
+
+#: random-projection dimension for the krum/multikrum/fltrust sketch.
+PROJ_DIM = 256
+#: per-coordinate histogram bins for the median/trimmed_mean sketch.
+HIST_BINS = 128
+#: histogram half-range in per-coordinate standard deviations.
+HIST_SPAN = 4.0
+_PROJ_SALT = 0x534B5348  # "SKSH"
+
+#: rules served by the coordinate-quantile sketch.
+QUANTILE_METHODS = ("median", "trimmed_mean")
+#: rules served by the random-projection sketch.
+PROJECTION_METHODS = ("krum", "multikrum", "fltrust")
+STREAM_METHODS = QUANTILE_METHODS + PROJECTION_METHODS
+
+
+class CoordMoments(NamedTuple):
+    """Pass-1 carry of the quantile sketch: exact per-coordinate
+    moments over the live rows (additive across blocks)."""
+
+    sum_x: jax.Array   # [D] f32
+    sum_sq: jax.Array  # [D] f32
+    count: jax.Array   # scalar f32 — live rows (screened rows included
+    #                    with their healed zero delta, like stacked)
+
+
+class ProjSketch(NamedTuple):
+    """Pass-1 carry of the projection sketch: per-SLOT rows, each block
+    scattering its own slots into zeros (disjoint, so the scan's
+    carry-add unions the blocks)."""
+
+    proj: jax.Array    # [slots, PROJ_DIM] f32 projected deltas
+    norm: jax.Array    # [slots] f32 true delta L2 norms
+    weight: jax.Array  # [slots] f32 aggregation weights (n_k)
+    live: jax.Array    # [slots] f32 live mask
+
+
+# ---------------------------------------------------------------------------
+# shared: flatten a block of stacked deltas
+# ---------------------------------------------------------------------------
+
+
+def flatten_rows(stacked_deltas: Pytree) -> jax.Array:
+    """``[B, D]`` f32 block of flattened deltas (one block's slice of
+    what :func:`robust.flatten_clients` builds for the whole cohort)."""
+    return robust.flatten_clients(stacked_deltas)
+
+
+# ---------------------------------------------------------------------------
+# coordinate-quantile sketch (median / trimmed_mean)
+# ---------------------------------------------------------------------------
+
+
+def fold_moments(flat: jax.Array, live: jax.Array) -> CoordMoments:
+    """One block's moment contribution; ``live`` is ``[B]`` f32."""
+    v = live[:, None]
+    return CoordMoments(
+        sum_x=jnp.sum(flat * v, axis=0),
+        sum_sq=jnp.sum(flat * flat * v, axis=0),
+        count=jnp.sum(live),
+    )
+
+
+def hist_edges(mom: CoordMoments,
+               span: float = HIST_SPAN) -> tuple[jax.Array, jax.Array]:
+    """Per-coordinate histogram geometry ``(lo, width)`` from the
+    pass-1 moments: bins span ``mu ± span·sd``. A zero-spread
+    coordinate gets ``width == 0`` — every estimate below then
+    collapses exactly to ``lo == mu``."""
+    n = jnp.maximum(mom.count, 1.0)
+    mu = mom.sum_x / n
+    var = jnp.maximum(mom.sum_sq / n - mu * mu, 0.0)
+    sd = jnp.sqrt(var)
+    lo = mu - span * sd
+    width = (2.0 * span * sd) / HIST_BINS
+    return lo, width
+
+
+def fold_hist(flat: jax.Array, live: jax.Array, lo: jax.Array,
+              width: jax.Array) -> jax.Array:
+    """One block's ``[HIST_BINS, D]`` histogram contribution, built as
+    a FLAT scatter-add (``bin·D + coordinate``) — never the
+    ``[B, HIST_BINS, D]`` one-hot blowup. Out-of-span values clip into
+    the edge bins (they are beyond ``span`` sigmas; the quantile bands
+    the defenses read live in the interior)."""
+    d = flat.shape[1]
+    safe_w = jnp.where(width > 0, width, 1.0)
+    b = jnp.clip(
+        jnp.floor((flat - lo[None, :]) / safe_w[None, :]),
+        0, HIST_BINS - 1,
+    ).astype(jnp.int32)
+    flat_idx = b * d + jnp.arange(d, dtype=jnp.int32)[None, :]
+    hist = jnp.zeros((HIST_BINS * d,), jnp.float32)
+    hist = hist.at[flat_idx.ravel()].add(
+        jnp.broadcast_to(live[:, None], flat.shape).ravel()
+    )
+    return hist.reshape(HIST_BINS, d)
+
+
+def median_from_hist(hist: jax.Array, lo: jax.Array, width: jax.Array,
+                     count: jax.Array) -> jax.Array:
+    """``[D]`` grouped-median: linear CDF interpolation at ``count/2``
+    inside the bin where the cumulative mass crosses it. Within one bin
+    width of the stacked order-statistic median; exact (``== mu``) for
+    zero-spread coordinates."""
+    cum = jnp.cumsum(hist, axis=0)  # [BINS, D]
+    target = jnp.maximum(count, 1.0) / 2.0
+    b = jnp.argmax(cum >= target, axis=0)  # [D] first crossing bin
+    cum_before = jnp.where(
+        b > 0,
+        jnp.take_along_axis(cum, jnp.maximum(b - 1, 0)[None, :],
+                            axis=0)[0],
+        0.0,
+    )
+    mass = jnp.take_along_axis(hist, b[None, :], axis=0)[0]
+    frac = (target - cum_before) / jnp.maximum(mass, 1e-12)
+    return lo + (b.astype(jnp.float32) + frac) * width
+
+
+def trim_table(trim_frac: float, c_max: int) -> jax.Array:
+    """Host-side trim-count table over every possible live count —
+    the SAME Python-float formula as :func:`robust.trimmed_mean` (so
+    the streamed and stacked rules trim identical row counts)."""
+    return jnp.asarray(
+        [max(0, min(int(c * trim_frac), (c - 1) // 2))
+         for c in range(c_max + 1)], jnp.int32,
+    )
+
+
+def trimmed_mean_from_hist(hist: jax.Array, lo: jax.Array,
+                           width: jax.Array, count: jax.Array,
+                           ks: jax.Array) -> jax.Array:
+    """``[D]`` trimmed mean from the histogram: per coordinate, the
+    mass of the rank band ``[k, n-k)`` — each bin contributes its
+    clamped overlap with the band, valued at the bin CENTER — divided
+    by ``n - 2k``. Within one bin width of the stacked rule (each
+    surviving value is off by at most half a bin from its center, plus
+    band-edge attribution of at most one bin)."""
+    n = jnp.maximum(count, 1.0)
+    k = ks[jnp.clip(count.astype(jnp.int32), 0, ks.shape[0] - 1)]
+    lo_rank = k.astype(jnp.float32)
+    hi_rank = n - lo_rank
+    cum = jnp.cumsum(hist, axis=0)  # [BINS, D]
+    cum_prev = jnp.concatenate(
+        [jnp.zeros((1,) + cum.shape[1:], cum.dtype), cum[:-1]], axis=0
+    )
+    band = jnp.clip(
+        jnp.minimum(cum, hi_rank) - jnp.maximum(cum_prev, lo_rank),
+        0.0, None,
+    )
+    centers = (
+        lo[None, :]
+        + (jnp.arange(HIST_BINS, dtype=jnp.float32)[:, None] + 0.5)
+        * width[None, :]
+    )
+    return jnp.sum(band * centers, axis=0) / jnp.maximum(
+        hi_rank - lo_rank, 1.0
+    )
+
+
+# ---------------------------------------------------------------------------
+# random-projection sketch (krum / multikrum / fltrust)
+# ---------------------------------------------------------------------------
+
+
+def project_rows(stacked_deltas: Pytree, rkey: jax.Array,
+                 proj_dim: int = PROJ_DIM) -> jax.Array:
+    """``[B, P]`` seeded Johnson–Lindenstrauss projection of each row's
+    flattened delta, scaled ``1/sqrt(P)`` so squared distances are
+    preserved in expectation. The per-leaf ``[d_leaf, P]`` Gaussian
+    blocks derive from ``(round key, salt, leaf index)`` — identical
+    across blocks and across the two passes of one round, never stored
+    (transient memory O(largest leaf · P))."""
+    base = jax.random.fold_in(rkey, _PROJ_SALT)
+    leaves = jax.tree.leaves(stacked_deltas)
+    b = leaves[0].shape[0]
+    acc = jnp.zeros((b, proj_dim), jnp.float32)
+    for i, leaf in enumerate(leaves):
+        flat = leaf.reshape(b, -1).astype(jnp.float32)
+        g = jax.random.normal(
+            jax.random.fold_in(base, i),
+            (flat.shape[1], proj_dim), jnp.float32,
+        )
+        acc = acc + flat @ g
+    return acc / jnp.sqrt(float(proj_dim))
+
+
+def fold_proj(stacked_deltas: Pytree, n_k: jax.Array, live: jax.Array,
+              positions: jax.Array, n_slots: int,
+              rkey: jax.Array) -> ProjSketch:
+    """One block's slot-scattered sketch rows: zero everywhere except
+    this block's ``positions`` (blocks partition the slot range, so the
+    scan's carry-add assembles the full per-slot arrays collision-
+    free)."""
+    proj = project_rows(stacked_deltas, rkey)
+    norms = jax.vmap(T.tree_l2_norm)(stacked_deltas).astype(jnp.float32)
+
+    def scatter(vals, shape):
+        return jnp.zeros(shape, jnp.float32).at[positions].set(
+            vals.astype(jnp.float32)
+        )
+
+    return ProjSketch(
+        proj=scatter(proj, (n_slots, proj.shape[1])),
+        norm=scatter(norms, (n_slots,)),
+        weight=scatter(n_k, (n_slots,)),
+        live=scatter(live, (n_slots,)),
+    )
+
+
+def selection_weights(method: str, sk: ProjSketch, num_adversaries: int,
+                      multikrum_m: int
+                      ) -> tuple[jax.Array, jax.Array]:
+    """Per-slot aggregation weights ``(w, den)`` decided from the
+    pass-1 sketch; pass 2 folds ``sum_i w_i·delta_i`` and the round
+    aggregate is ``wsum / den``. Eligibility is ``live & weight > 0``
+    — the stacked reducer's ``gw = where(valid, weights, 0); w > 0``
+    semantics."""
+    slots = sk.proj.shape[0]
+    valid = (sk.live > 0) & (sk.weight > 0)
+    n_valid = jnp.sum(valid.astype(jnp.int32))
+    if method in ("krum", "multikrum"):
+        rows = jnp.arange(slots, dtype=jnp.int32)
+        d2 = robust.pairwise_sq_dists_rows(sk.proj, rows, sk.proj)
+        scores = robust.krum_scores_rows(
+            d2, rows, num_adversaries, valid, n_valid
+        )
+        if method == "krum":
+            # the selected client's delta IS the aggregate: a one-hot
+            # weight makes pass 2's weighted sum reproduce it exactly
+            # (0·x is exact for the finite, screened-healed rows)
+            w = jax.nn.one_hot(jnp.argmin(scores), slots,
+                               dtype=jnp.float32)
+            return w, jnp.asarray(1.0, jnp.float32)
+        m_dyn = (
+            jnp.asarray(multikrum_m) if multikrum_m > 0
+            else jnp.maximum(1, n_valid - num_adversaries)
+        )
+        m_dyn = jnp.clip(m_dyn, 1, jnp.maximum(n_valid, 1))
+        order = jnp.argsort(scores)
+        rank = jnp.zeros((slots,), jnp.int32).at[order].set(
+            jnp.arange(slots, dtype=jnp.int32)
+        )
+        mask = (rank < m_dyn) & valid
+        w = jnp.where(mask, sk.weight, 0.0)
+        return w, jnp.maximum(jnp.sum(w), 1e-12)
+    if method == "fltrust":
+        eps = 1e-12
+        vf = valid.astype(jnp.float32)
+        # reference = coordinate-median of the PROJECTED valid rows;
+        # norm-match target = the median TRUE cohort norm (documented
+        # divergence from the stacked rule's full-D reference)
+        ref = robust.coordinate_median(sk.proj, valid)  # [P]
+        rn_p = jnp.sqrt(jnp.sum(ref * ref))
+        xn_p = jnp.sqrt(jnp.sum(sk.proj * sk.proj, axis=1))
+        cos = (sk.proj @ ref) / jnp.maximum(xn_p * rn_p, eps)
+        trust = jax.nn.relu(cos) * vf
+        rn = robust.coordinate_median(sk.norm, valid)  # scalar
+        norm_match = rn / jnp.maximum(sk.norm, eps)
+        tsum = jnp.sum(trust)
+        w = (trust / jnp.maximum(tsum, eps)) * norm_match
+        # zero total trust degrades to a ZERO aggregate (the stacked
+        # rule returns its full-D reference — unavailable at O(sketch))
+        w = jnp.where(tsum > 0, w, 0.0)
+        return w, jnp.asarray(1.0, jnp.float32)
+    raise ValueError(f"not a streaming selection method: {method!r}")
+
+
+# ---------------------------------------------------------------------------
+# telemetry
+# ---------------------------------------------------------------------------
+
+
+def sketch_mb(method: str, flat_dim: int, n_slots: int) -> float:
+    """Resident sketch-carry size (the O(sketch) the round pays instead
+    of O(C·D))."""
+    if method in QUANTILE_METHODS:
+        return 4.0 * flat_dim * (HIST_BINS + 2) / 1e6
+    return 4.0 * n_slots * (PROJ_DIM + 3) / 1e6
+
+
+def note_defense(method: str, flat_dim: int, n_slots: int) -> None:
+    """Gauges at bulk dispatch (docs/OBSERVABILITY.md vocabulary)."""
+    m = telemetry.METRICS
+    if not m.enabled:
+        return
+    m.gauge("defense.sketch_bins",
+            float(HIST_BINS if method in QUANTILE_METHODS else 0))
+    m.gauge("defense.sketch_proj_dim",
+            float(PROJ_DIM if method in PROJECTION_METHODS else 0))
+    m.gauge("defense.sketch_mb", sketch_mb(method, flat_dim, n_slots))

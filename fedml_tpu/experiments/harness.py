@@ -390,7 +390,9 @@ class Experiment:
                     cfg.out_dir, "ckpt"
                 )
             )
-            state, start_round = ckpt.restore_or(state)
+            state, start_round = Experiment._restore_state(
+                ckpt, sim, state
+            )
             if start_round:
                 sink.log({"resumed_from": start_round})
         elif checkpointable:
@@ -499,7 +501,46 @@ class Experiment:
                 (r + 1) % cfg.checkpoint_every == 0
                 or r == cfg.fed.num_rounds - 1
             ):
-                ckpt.save(r, state)
+                Experiment._save_state(ckpt, sim, r, state)
+
+    @staticmethod
+    def _save_state(ckpt, sim, r, state):
+        """Checkpoint one round: sims carrying client-state banks
+        (docs/FAULT_TOLERANCE.md "Client-state banks" — the compress
+        error-feedback residual, the PEFT private adapter bank) save
+        the ``{"server": state, "bank": {name: rows}}`` composite so a
+        SIGKILLed run restores every client's row bitwise; bankless
+        sims keep the bare-state layout unchanged."""
+        banks = sim.bank_state() if hasattr(sim, "bank_state") else {}
+        if banks:
+            ckpt.save(r, {"server": state, "bank": banks})
+        else:
+            ckpt.save(r, state)
+
+    @staticmethod
+    def _restore_state(ckpt, sim, state):
+        """The restore half of :meth:`_save_state`. Bank-aware sims
+        restore through the raw (template-free) path so the composite's
+        variable bank payload never has to match a shape template; a
+        legacy bare-state checkpoint (or a composite from a config
+        without this sim's banks) restores the server state and leaves
+        the lazily-initialized fresh banks in place — exactly what the
+        pre-bank checkpoint encoded."""
+        if not (hasattr(sim, "restore_banks")
+                and hasattr(sim, "bank_state")):
+            return ckpt.restore_or(state)
+        raw, nxt = ckpt.restore_raw()
+        if raw is None:
+            return state, 0
+        from fedml_tpu.utils.checkpoint import from_savable
+
+        bank_blob = None
+        if isinstance(raw, dict) and "server" in raw:
+            bank_blob = raw.get("bank")
+            raw = raw["server"]
+        restored = from_savable(state, raw)
+        sim.restore_banks(restored, bank_blob)
+        return restored, nxt
 
     @staticmethod
     def _eval_record(sim, state) -> dict:
@@ -570,7 +611,7 @@ class Experiment:
                 (r_last + 1) % cfg.checkpoint_every == 0
                 or r_last == total - 1
             ):
-                ckpt.save(r_last, box[0])
+                Experiment._save_state(ckpt, sim, r_last, box[0])
 
         F.drive(
             run_block,

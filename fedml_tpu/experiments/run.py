@@ -197,10 +197,13 @@ def parse_args(argv=None) -> tuple[ExperimentConfig, argparse.Namespace]:
                         "per-client bank — only the shared LM head "
                         "aggregates; client i's adapters never reach "
                         "the server or client j "
-                        "(fedml_tpu.peft.personal). Plain per-round "
-                        "simulator path only: bulk/elastic/compress/"
-                        "fuse/sharded/adversary combos are rejected "
-                        "at parse time")
+                        "(fedml_tpu.peft.personal). The bank is a "
+                        "client-state bank (core/statebank.py), so it "
+                        "composes with --client_block_size, "
+                        "--elastic, --fuse_rounds, the sharded "
+                        "runtime, and --checkpoint_every; compress / "
+                        "defended robust_method / adversary combos "
+                        "are rejected at parse time")
     # -- seeded Byzantine adversary injection (core/adversary.py) ----------
     p.add_argument("--adversary_mode", type=str, default=None,
                    choices=["none", "sign_flip", "scale_boost", "gauss",
@@ -320,13 +323,15 @@ def parse_args(argv=None) -> tuple[ExperimentConfig, argparse.Namespace]:
                         "is folded into an O(model) partial-sum scan "
                         "carry, so round memory is O(B + model) "
                         "instead of O(cohort) — the 10k-client-real-"
-                        "training path. mean/FedNova reduce rules "
-                        "only (selection defenses need the full "
-                        "stacked cohort and are rejected here at "
-                        "parse time); composes with --elastic (block-"
-                        "count buckets) and --fuse_rounds (nested "
-                        "scans); incompatible with --compress. "
-                        "0/unset = the stacked [C, ...] round")
+                        "training path. Composes with --elastic "
+                        "(block-count buckets), --fuse_rounds (nested "
+                        "scans), --compress (client-id-keyed error-"
+                        "feedback bank, core/statebank.py), "
+                        "--peft_personalize (streamed adapter bank), "
+                        "every --robust_method (streamed defense "
+                        "sketches, core/streamdef.py), and every "
+                        "adversary mode. 0/unset = the stacked "
+                        "[C, ...] round")
     # -- performance observability (docs/OBSERVABILITY.md) -----------------
     p.add_argument("--profile_rounds", type=int, default=None,
                    help="capture a jax.profiler window around each of "
@@ -651,14 +656,14 @@ def parse_args(argv=None) -> tuple[ExperimentConfig, argparse.Namespace]:
                          evict_after=a.quarantine_evict_after)
         check_fednova_compat(cfg.fed.algorithm, cfg.fed.robust_method)
         AsyncConfig.from_fed(cfg.fed)
-        # bulk-client streaming: the whole compatibility matrix
-        # (selection defenses, compress, the gauss adversary) fails at
-        # parse time, not at simulator construction (fedlint
-        # parse-time-validation discipline). Only for processes that
+        # bulk-client streaming: the PR-14 composition walls (selection
+        # defenses, compress, the gauss adversary) have fallen — the
+        # client-state banks and streamed defense sketches carry them —
+        # so check_bulk_compat accepts everything; it stays called as
+        # the parse-time seam (fedlint parse-time-validation
+        # discipline) for any future wall. Only for processes that
         # will actually RUN a simulator: under --role/--supervise the
-        # flag is inert (warned below) and a shared config combining
-        # it with deploy-side compression must not hard-fail a rank
-        # the block size cannot affect.
+        # flag is inert (warned below).
         from fedml_tpu.core.bulk import BulkSpec, check_bulk_compat
 
         bulk = BulkSpec.from_fed(cfg.fed)

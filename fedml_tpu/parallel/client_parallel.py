@@ -43,6 +43,8 @@ from fedml_tpu.core import bulk as BK
 from fedml_tpu.core import elastic as E
 from fedml_tpu.core import memscope as M
 from fedml_tpu.core import random as R
+from fedml_tpu.core import robust
+from fedml_tpu.core import statebank as SB
 from fedml_tpu.data.federated import FederatedData, shard_client_banks
 from fedml_tpu.algorithms.base import (
     build_cohort_local_update,
@@ -103,18 +105,21 @@ class ShardedFedAvg(FedAvgSim):
                 "serialized wire); model the codec on FedAvgSim or "
                 "the --role deploy path, or set compress='none'"
             )
-        if getattr(cfg.fed, "peft_personalize", False):
-            # the per-client adapter bank is a single-device donated
-            # operand; sharding it over the client axis (per-shard
-            # bank slices + the gather/scatter seam) is future work —
-            # reject rather than silently train a shared-adapter run
-            # under a "personalized" label
+        if (cfg.fed.client_block_size > 0
+                and cfg.fed.robust_method not in ("mean", "", None)):
+            # the streamed defense sketches (core/streamdef.py) fold
+            # through ONE device's block scan; under shard_map each
+            # shard would sketch only its own sub-cohort and the
+            # cross-shard combine (histogram merge, projection
+            # all_gather) is not built — reject rather than silently
+            # defend each shard against only its local adversaries
             raise ValueError(
-                "peft_personalize is not wired into the mesh-sharded "
-                "runtime (the private adapter bank lives on one "
-                "device); run personalized PEFT on FedAvgSim, or drop "
-                "peft_personalize (non-personalized peft='lora' "
-                "composes with the sharded round)"
+                "streamed Byzantine defenses are not wired into the "
+                "mesh-sharded bulk round (the defense sketches fold "
+                "on one device; the cross-shard sketch combine is not "
+                "built); run defended bulk rounds on FedAvgSim, use "
+                "the stacked sharded round (client_block_size=0), or "
+                "set robust_method='mean'"
             )
         self.mesh = mesh
         self.client_axis = cfg.mesh.client_axis_name
@@ -206,14 +211,19 @@ class ShardedFedAvg(FedAvgSim):
             self._max_live = self._shard_max_live * self.n_client_shards
         # instrumented AOT site like the single-device round
         # (core/memscope.py): compile wall + memory_analysis recorded
-        # per program, the donated state audited on first execution
+        # per program, the donated state audited on first execution.
+        # Personalized PEFT donates the adapter ClientStateBank too
+        # (operand 4, the single-device layout) — it shards over the
+        # client axis inside the round, each shard owning its own
+        # K-row slice.
+        personalized = self._peft is not None and self._peft.personalized
         self._round_fn = M.ProgramSite(
             self._sharded_round,
             family=(
                 "sharded_bulk" if self._bulk.enabled()
                 else "sharded_round"
             ),
-            donate_argnums=(0,),
+            donate_argnums=(0, 4) if personalized else (0,),
         )
         # round fusion (docs/PERFORMANCE.md "Round fusion"): the
         # inherited _fused_block scans over whatever _round_impl names
@@ -278,7 +288,19 @@ class ShardedFedAvg(FedAvgSim):
         )
         assert self.banks.max_client_samples == self.arrays.max_client_samples
 
-    def _sharded_round(self, state: ServerState, banks, n_active=None):
+    def _sharded_round(self, state: ServerState, banks, n_active=None,
+                       residual=None, bank=None):
+        """One mesh round. The trailing ``(residual, bank)`` operands
+        mirror :meth:`FedAvgSim._round`'s layout (the inherited fused
+        block calls through it): compression is rejected at
+        construction so ``residual`` is always None; ``bank`` is the
+        personalized-PEFT adapter :class:`~fedml_tpu.core.statebank.
+        ClientStateBank`, sharded over the client axis — inside the
+        shard each body sees its own ``[K, ...]`` slice (local ids,
+        local sentinel ``K``) and returns the updated slice, which
+        shard_map stitches back to the full ``[num_clients, ...]``
+        bank."""
+        del residual  # compress is rejected at construction
         cfg = self.cfg.fed
         rkey = R.round_key(self.root_key, state.round)
         ckey = jax.random.fold_in(rkey, 0)
@@ -289,15 +311,25 @@ class ShardedFedAvg(FedAvgSim):
         rep = P()
         red = psum_reducer(self.client_axis)
 
-        def shard_fn(state, x, y, idx, mask, *maybe_n):
+        def shard_fn(state, x, y, idx, mask, *rest):
             # leading shard axis arrives with extent 1 inside the shard
             x, y = x[0], y[0]
             idx, mask = idx[0], mask[0]
-            n_act = maybe_n[0] if maybe_n else None
+            rest = list(rest)
+            # the bank slice's leading axis is the CLIENT axis itself
+            # (num_clients -> K per shard): no extent-1 unwrap
+            bank_l = rest.pop(0) if bank is not None else None
+            n_act = rest[0] if rest else None
             shard = jax.lax.axis_index(self.client_axis)
             if self._bulk.enabled():
                 return self._bulk_shard_body(
-                    state, x, y, idx, mask, shard, rkey, ckey, K, n_act
+                    state, x, y, idx, mask, shard, rkey, ckey, K, n_act,
+                    bank_l,
+                )
+            if bank_l is not None:
+                return self._personal_shard_body(
+                    state, x, y, idx, mask, shard, rkey, ckey, K, Kb,
+                    n_act, bank_l, red,
                 )
             # stratified cohort: this shard samples its own clients (LOCAL
             # ids); keys use GLOBAL client ids so the host mirror matches.
@@ -360,22 +392,29 @@ class ShardedFedAvg(FedAvgSim):
 
         in_specs = (rep, cspec, cspec, cspec, cspec)
         operands = (state, banks.x, banks.y, banks.idx, banks.mask)
+        if bank is not None:
+            # the adapter bank shards like the sample banks: P on the
+            # leading (client) axis of every row leaf — shard s owns
+            # rows [s*K, (s+1)*K) of the global bank
+            in_specs += (cspec,)
+            operands += (bank,)
         if n_active is not None:
             # the live count is a REPLICATED operand (not a closure):
             # closed-over tracers under shard_map are version-fragile
             in_specs += (rep,)
             operands += (n_active,)
-        new_state, metrics = shard_map(
+        out_specs = (rep, rep, cspec) if bank is not None else (rep, rep)
+        out = shard_map(
             shard_fn,
             mesh=self.mesh,
             in_specs=in_specs,
-            out_specs=(rep, rep),
+            out_specs=out_specs,
             check_vma=False,
         )(*operands)
-        return new_state, metrics
+        return out
 
     def _bulk_shard_body(self, state, x, y, idx, mask, shard, rkey,
-                         ckey, K, n_act):
+                         ckey, K, n_act, bank=None):
         """One shard's bulk round body (runs inside the shard_map):
         stream THIS shard's sub-cohort through fixed-size blocks
         folding O(model) partials, then psum the partials over the
@@ -396,8 +435,13 @@ class ShardedFedAvg(FedAvgSim):
         local = R.sample_stratum(ckey, shard, K, draw)
         pad = S - draw
         if pad:
+            # the LOCAL sentinel (= K, this shard's row count): the
+            # clamped sample-bank gather reads a real row but the slot
+            # is masked below, and a ClientStateBank scatter DROPS the
+            # write entirely (mode="drop") — a padded slot can never
+            # alias client 0's bank row
             local = jnp.concatenate(
-                [local, jnp.zeros((pad,), jnp.int32)]
+                [local, jnp.full((pad,), K, jnp.int32)]
             )
         if n_act is not None:
             live = E.active_mask(S, n_act // self.n_client_shards)
@@ -405,6 +449,11 @@ class ShardedFedAvg(FedAvgSim):
             live = E.active_mask(S, self.cohort_per_shard)
         else:
             live = None
+        if bank is not None:
+            return self._bulk_shard_personal(
+                state, view, x, y, idx, mask, shard, rkey, K, local,
+                live, bank,
+            )
 
         def fold_block(block_ids, block_live):
             ckeys = jax.vmap(
@@ -444,6 +493,150 @@ class ShardedFedAvg(FedAvgSim):
             "train_loss": fin["loss"], "train_acc": fin["acc"],
         }
 
+    def _local_personal_update(self, state, x, y, idx, mask,
+                               shard, rkey, K, ids, priv):
+        """One stacked group of personalized local updates on THIS
+        shard: merge each client's private adapter row into the shared
+        model, train, and split the result back into (shared, private)
+        halves — the per-shard twin of the bodies in
+        :meth:`FedAvgSim._personal_round` / ``_bulk_personal``. ``ids``
+        are LOCAL (in ``[0, K)``, sentinel ``K``); client keys use the
+        GLOBAL id ``shard*K + c`` so the host stratified mirror
+        matches."""
+        plan = self._peft
+        base_frozen = plan.private.frozen(state.variables["params"])
+        ckeys = jax.vmap(
+            lambda c: R.client_key(rkey, shard * K + c)
+        )(ids)
+
+        def one(priv_row, idx_row, mask_row, key):
+            params_c = plan.private.merge(priv_row, base_frozen)
+            vars_c = {**state.variables, "params": params_c}
+            out_vars, n_k, msums = self.local_update(
+                vars_c, idx_row, mask_row, x, y, key
+            )
+            trained = out_vars["params"]
+            shared = {
+                **{k: v for k, v in out_vars.items() if k != "params"},
+                "params": plan.private.frozen(trained),
+            }
+            return (shared, plan.private.trainable(trained), n_k,
+                    msums)
+
+        return jax.vmap(one)(priv, idx[ids], mask[ids], ckeys)
+
+    @staticmethod
+    def _screen_personal(view, shared, new_priv, n_k, msums, live):
+        """The both-halves non-finite screen shared by the stacked and
+        bulk personal shard bodies (same contract as the single-device
+        paths): a poisoned client contributes nothing to the shared
+        aggregate AND keeps its pre-round bank row; non-live slots are
+        healed/zero-weight and are neither rejections nor bank writes.
+        Returns ``(shared, n_k, keep, rejected)``."""
+        if live is not None:
+            shared, n_k, msums = E.mask_padded(
+                shared, n_k, msums, view.variables, live
+            )
+        ok = robust.finite_client_mask(
+            {"shared": shared, "private": new_priv}, n_k
+        )
+        lv = jnp.ones(ok.shape, bool) if live is None else live
+        ok = ok | ~lv
+
+        def heal(s, g):
+            m = ok.reshape((-1,) + (1,) * (s.ndim - 1))
+            return jnp.where(m, s, g[None].astype(s.dtype))
+
+        shared = jax.tree.map(heal, shared, view.variables)
+        n_k = jnp.where(ok, n_k, jnp.zeros_like(n_k))
+        rejected = (ok.shape[0] - jnp.sum(ok)).astype(jnp.float32)
+        return shared, n_k, msums, ok & lv, rejected
+
+    def _personal_shard_body(self, state, x, y, idx, mask, shard, rkey,
+                             ckey, K, Kb, n_act, bank, red):
+        """Stacked personalized round on one shard: gather this
+        shard's cohort rows from its bank SLICE, train merged, psum
+        only the SHARED half, scatter the trained rows back. The
+        no-leak contract is structural exactly as on the single-device
+        path — the psum'd view does not contain the private paths, and
+        each bank row is written only from its own client's update."""
+        cfg = self.cfg.fed
+        plan = self._peft
+        local = R.sample_stratum(ckey, shard, K, Kb)
+        priv = bank.gather(local)
+        shared, new_priv, n_k, msums = self._local_personal_update(
+            state, x, y, idx, mask, shard, rkey, K, local, priv,
+        )
+        view = plan.view_state(state)
+        live = None
+        if n_act is not None:
+            live = E.active_mask(Kb, n_act // self.n_client_shards)
+        shared, n_k, msums, keep, rejected = self._screen_personal(
+            view, shared, new_priv, n_k, msums, live
+        )
+        new_state = server_update(
+            cfg, self.cfg.train, self.steps_per_epoch,
+            self.batch_size, view, shared, n_k, rkey, red, valid=live,
+        )
+        new_state = plan.merge_state(new_state, state)
+        new_bank = bank.put(local, new_priv, keep=keep, gathered=priv)
+        reduced = jax.tree.map(
+            lambda v: jax.lax.psum(jnp.sum(v), self.client_axis), msums
+        )
+        fin = finalize_sums(reduced)
+        metrics = {
+            "train_loss": fin["loss"],
+            "train_acc": fin["acc"],
+            "nonfinite_rejected": jax.lax.psum(
+                rejected, self.client_axis
+            ),
+        }
+        return new_state, metrics, new_bank
+
+    def _bulk_shard_personal(self, state, view, x, y, idx, mask, shard,
+                             rkey, K, local, live, bank):
+        """Personalized PEFT x bulk x mesh: each shard streams its
+        sub-cohort through blocks, gathering/scattering its bank SLICE
+        through the block scan carry (local sentinel ``K`` — padded
+        slots read a clamped row but never write one), then psums the
+        O(model) shared partials. The bank never crosses the mesh: it
+        is already partitioned the way the round consumes it."""
+        cfg = self.cfg.fed
+        plan = self._peft
+
+        def fold_block(block_ids, block_live, bk):
+            priv = bk.gather(block_ids)
+            shared, new_priv, n_k, msums = self._local_personal_update(
+                state, x, y, idx, mask, shard, rkey, K, block_ids,
+                priv,
+            )
+            shared, n_k, msums, keep, rejected = self._screen_personal(
+                view, shared, new_priv, n_k, msums, block_live
+            )
+            bk = bk.put(block_ids, new_priv, keep=keep, gathered=priv)
+            p = fold_block_partials(
+                cfg, self.cfg.train, self.steps_per_epoch,
+                self.batch_size, view, shared, n_k, msums, rejected,
+            )
+            return p, bk
+
+        partials, bank = BK.stream_blocks(
+            fold_block, local, live, self._block_size, banks=bank
+        )
+        partials = jax.tree.map(
+            lambda v: jax.lax.psum(v, self.client_axis), partials
+        )
+        new_state = server_update_from_partials(
+            cfg, view, partials, rkey
+        )
+        new_state = plan.merge_state(new_state, state)
+        fin = finalize_sums(partials.msums)
+        return new_state, {
+            "train_loss": fin["loss"],
+            "train_acc": fin["acc"],
+            "nonfinite_rejected": partials.rejected,
+        }, bank
+
     def _program_key(self) -> tuple:
         return (self._shard_blocks, self._block_size)
 
@@ -451,25 +644,41 @@ class ShardedFedAvg(FedAvgSim):
         return self.banks
 
     def run_round(self, state):
+        personalized = (
+            self._peft is not None and self._peft.personalized
+        )
         if self._bulk.enabled():
             self._note_bulk_dispatch()
             key = self._program_key()
-            if not self._elastic:
-                return self._round_fn(key, state, self.banks)
-            return E.mirror_jit_cache(
-                self._round_fn,
-                lambda: self._round_fn(
-                    key, state, self.banks,
-                    jnp.asarray(self._n_active, jnp.int32),
-                ),
+        else:
+            key = self.bucket_per_shard
+        n = (
+            jnp.asarray(self._n_active, jnp.int32)
+            if self._elastic else None
+        )
+        if personalized:
+            # the adapter bank is a donated operand and comes back
+            # updated (the single-device thread-through discipline);
+            # per round each shard gathers+scatters its own slice once
+            # per block
+            self._ensure_adapter_bank(state)
+
+            def call():
+                return self._round_fn(
+                    key, state, self.banks, n, None,
+                    self._bank_adapter,
+                )
+
+            state, m, self._bank_adapter = (
+                E.mirror_jit_cache(self._round_fn, call)
+                if self._elastic else call()
             )
-        key = self.bucket_per_shard
+            io = self._n_blocks if self._bulk.enabled() else 1
+            SB.note_round_io(io, io)
+            return state, m
         if not self._elastic:
             return self._round_fn(key, state, self.banks)
         return E.mirror_jit_cache(
             self._round_fn,
-            lambda: self._round_fn(
-                key, state, self.banks,
-                jnp.asarray(self._n_active, jnp.int32),
-            ),
+            lambda: self._round_fn(key, state, self.banks, n),
         )

@@ -65,9 +65,25 @@ def check_peft_compat(fed, adversary=None, checkpoint_every: int = 0) -> None:
     non-personalized adapter path composes with everything (codec,
     bulk streaming, round fusion, elastic buckets, defenses, the
     sharded runtime — the aggregation stack is tree-generic and just
-    sees a smaller tree); personalization's per-client bank is
-    supported on the plain per-round path only."""
+    sees a smaller tree). Personalization's per-client bank now lives
+    in a client-id-keyed :class:`~fedml_tpu.core.statebank.
+    ClientStateBank`, which rides the bulk scan carry, the fused-round
+    scan carry, the elastic bucket (sentinel-padded, non-live rows
+    preserved), the sharded runtime's client axis, AND the round
+    checkpoint composite — those PR 15 walls have fallen. What remains
+    rejected, with reasons:
+
+    - ``compress``: the codec's error-feedback residual assumes the
+      aggregated subtree is the whole client update, but a
+      personalized client also carries private adapters that never
+      ride the wire;
+    - defended ``robust_method``: the selection rules are untested
+      against the head-only shared aggregate and are rejected loudly
+      rather than run unvalidated;
+    - ``adversary``: the injection gate rewrites the aggregated
+      stacked variables and has no private-bank seam."""
     spec = LoRASpec.from_fed(fed)
+    del checkpoint_every  # the bank rides the checkpoint composite now
     personalize = bool(getattr(fed, "peft_personalize", False))
     if not personalize:
         return
@@ -76,21 +92,6 @@ def check_peft_compat(fed, adversary=None, checkpoint_every: int = 0) -> None:
             "peft_personalize requires peft='lora': without adapters "
             "there is no private subtree to personalize"
         )
-    if getattr(fed, "client_block_size", 0):
-        raise ValueError(
-            "peft_personalize is incompatible with bulk "
-            "(client_block_size) execution: the per-client adapter "
-            "bank gather/scatter needs the cohort's identity rows, "
-            "which the O(block) streaming reduce folds away. Run "
-            "personalized PEFT on the stacked path "
-            "(client_block_size=0)."
-        )
-    if getattr(fed, "elastic_buckets", False):
-        raise ValueError(
-            "peft_personalize is incompatible with elastic_buckets: "
-            "a padded slot has no bank row to train or write back — "
-            "run personalized PEFT on the static cohort path"
-        )
     if getattr(fed, "compress", "none") not in ("none", "", None):
         raise ValueError(
             "peft_personalize is incompatible with compress: the "
@@ -98,13 +99,6 @@ def check_peft_compat(fed, adversary=None, checkpoint_every: int = 0) -> None:
             "the aggregated subtree is the whole client update, but "
             "a personalized client also carries private adapters "
             "that never ride the wire. Compress composes with "
-            "NON-personalized peft='lora'."
-        )
-    if int(getattr(fed, "fuse_rounds", 1) or 1) > 1:
-        raise ValueError(
-            "peft_personalize is incompatible with fuse_rounds > 1: "
-            "the adapter bank is a per-round donated operand, not a "
-            "fused scan carry. Round fusion composes with "
             "NON-personalized peft='lora'."
         )
     if getattr(fed, "robust_method", "mean") not in ("mean", "", None):
@@ -120,15 +114,6 @@ def check_peft_compat(fed, adversary=None, checkpoint_every: int = 0) -> None:
             "injection: the injection gate rewrites the aggregated "
             "stacked variables and has no private-bank seam — run "
             "Byzantine scenarios on non-personalized peft='lora'"
-        )
-    if checkpoint_every:
-        raise ValueError(
-            "peft_personalize is incompatible with checkpoint_every: "
-            "the private adapter bank does not ride the round "
-            "checkpoint, so a resumed run would silently reset every "
-            "client's personalization to init while the shared state "
-            "resumes mid-run. Checkpointing composes with "
-            "NON-personalized peft='lora'."
         )
 
 

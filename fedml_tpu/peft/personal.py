@@ -23,12 +23,17 @@ train), so global evaluation measures exactly the shared model;
 :func:`personal_variables` builds the per-client personalized model
 for local evaluation.
 
-Honest scope: personalization runs on the plain per-round
-:class:`~fedml_tpu.algorithms.fedavg.FedAvgSim` path only — bulk
-streaming, elastic buckets, wire compression, round fusion, the
-mesh-sharded runtime, and adversary injection are rejected LOUDLY at
-parse/construction (:func:`fedml_tpu.peft.check_peft_compat`), never
-silently dropped.
+Honest scope: the bank rows live in a client-id-keyed
+:class:`~fedml_tpu.core.statebank.ClientStateBank`, so personalization
+composes with bulk streaming (per-block gather/scatter through the
+scan carry), elastic buckets (non-live slots keep their pre-round
+rows), round fusion (the bank is a fused scan carry), the mesh-sharded
+runtime (the bank shards over the client axis), and ``checkpoint_every``
+(the bank rides the checkpoint composite and restores bitwise —
+docs/FAULT_TOLERANCE.md "Client-state banks"). Wire compression,
+defended robust_method, and adversary injection remain rejected LOUDLY
+at parse/construction (:func:`fedml_tpu.peft.check_peft_compat`),
+never silently dropped.
 """
 
 from __future__ import annotations

@@ -78,12 +78,13 @@ class RoundCheckpointer:
         auto-numbered ``Dense_N`` heads) where current trees say
         ``Conv2D_N``/``ConvTranspose2D_N``/named heads; such checkpoints
         are migrated on restore by :func:`_migrate_scopes` instead of
-        failing the structure match. A checkpoint written by the deploy
-        server (a ``{"server", "reputation"}`` composite — the actor
-        persists its Byzantine-reputation plane alongside the round
-        state) restored against a bare sim-state template is unwrapped
-        to its ``"server"`` payload, so a deploy run and a sim run of
-        one config keep sharing the resume story in BOTH directions."""
+        failing the structure match. A composite checkpoint — the
+        deploy server's ``{"server", "reputation", ...}`` payload, or
+        the harness's ``{"server", "bank"}`` client-state save
+        (docs/FAULT_TOLERANCE.md "Client-state banks") — restored
+        against a bare sim-state template is unwrapped to its
+        ``"server"`` payload, so a deploy run and a sim run of one
+        config keep sharing the resume story in BOTH directions."""
         step = self._mgr.latest_step()
         if step is None:
             return init_state, 0
@@ -106,16 +107,19 @@ class RoundCheckpointer:
                 raw = self._mgr.restore(step)
                 if (
                     isinstance(raw, dict)
-                    and {"server", "reputation"} <= set(raw)
-                    # tolerate later composite additions (membership,
-                    # the async staleness buffer)
+                    and "server" in raw
+                    # tolerate the known composite siblings: the deploy
+                    # actor's reputation/membership/async planes and the
+                    # harness's client-state banks
                     and set(raw) <= {"server", "reputation",
-                                     "membership", "async"}
+                                     "membership", "async", "bank"}
                     and not (isinstance(template, dict)
                              and "server" in template)
                 ):
-                    # deploy-server composite restored by a sim-shaped
-                    # caller: the round state is the "server" payload
+                    # a composite checkpoint (deploy-server planes, or
+                    # the harness's {"server", "bank"} client-state
+                    # save) restored by a bare-state caller: the round
+                    # state is the "server" payload
                     raw = raw["server"]
                 restored = _migrate_scopes(template, raw)
             except Exception:

@@ -15,12 +15,15 @@ The contract, in tiers:
    the PR-5/PR-7/PR-10 tier.
 3. **O(block) memory**: the compiled bulk program's analytic footprint
    is flat in C at fixed B (temp bytes within 1.5x across a 4x cohort
-   sweep) while the stacked program's O(C) law is unchanged — and no
-   O(C) buffer can sneak back in through composition (compress is
-   rejected at construction).
-4. **Loud rejection**: selection/gather defenses, compression, and the
-   gauss adversary fail at CONSTRUCTION with precise errors — never a
-   silent approximation.
+   sweep) while the stacked program's O(C) law is unchanged.
+4. **Composition**: the PR-14 walls have fallen — compress rides a
+   client-id-keyed error-feedback ClientStateBank through the block
+   scan carry (core/statebank.py; convergence + telescoping pins in
+   tests/test_statebank.py), selection/gather defenses run as
+   block-folded streaming sketches (core/streamdef.py; parity bands +
+   the adversary-recovery battery in tests/test_streamdef.py), and the
+   gauss adversary keys per row on (round, client id). The quick
+   construction-and-round acceptance pins live here.
 5. **Elasticity**: cohort churn within the compiled block grid is a
    compile-cache hit; the donation audit passes on the block program.
 """
@@ -298,36 +301,54 @@ def test_bulk_program_footprint_flat_in_cohort():
         M.reset()
 
 
-def test_bulk_rejects_compress_no_oc_residual():
-    """compress + bulk would reintroduce the O(C) error-feedback
-    residual bank — rejected at construction with a precise error, so
-    bulk mode cannot silently grow an O(C) buffer back."""
-    with pytest.raises(ValueError, match="error-feedback residual"):
-        _sim(_cfg(client_block_size=4, compress="int8"))
-    with pytest.raises(ValueError, match="error-feedback residual"):
-        _sim(_cfg(client_block_size=4, compress="topk_int8"))
+def test_bulk_compress_composes():
+    """compress + bulk: the error-feedback residual lives in a
+    client-id-keyed ClientStateBank threaded through the block scan
+    carry (core/statebank.py), so the codec no longer reintroduces an
+    O(cohort)-shaped round operand — construction succeeds and the
+    compressed bulk run converges. (The client-id-vs-slot keying
+    contract and the telescoping pin live in tests/test_statebank.py.)"""
+    sim = _sim(_cfg(client_block_size=4, compress="int8"))
+    _, ms = _run(sim, 3)
+    assert sim._ef_bank is not None
+    assert sim._ef_bank.num_rows == 8  # one row per CLIENT, not slot
+    assert ms[-1]["train_loss"] < ms[0]["train_loss"]
+    # both codecs construct
+    _sim(_cfg(client_block_size=4, compress="topk_int8"))
 
 
 # ---------------------------------------------------------------------------
-# 4. loud rejection of the full-stack rules
+# 4. full-stack composition: the PR-14 walls stay down
 # ---------------------------------------------------------------------------
 
 
 @pytest.mark.parametrize(
     "method", ["median", "trimmed_mean", "krum", "multikrum", "fltrust"]
 )
-def test_bulk_rejects_selection_defenses(method):
+def test_bulk_selection_defenses_compose(method):
+    """Every selection/gather defense now runs at bulk scale as a
+    block-folded streaming sketch (core/streamdef.py): construction
+    succeeds and a defended bulk round stays finite on clean data.
+    (Accuracy bands vs the stacked defenses and the adversary-recovery
+    battery live in tests/test_streamdef.py.)"""
     kw = {"robust_method": method}
     if method == "krum" or method == "multikrum":
         kw["robust_num_adversaries"] = 1
-    with pytest.raises(ValueError, match="full \\[C, D\\] stacked"):
-        _sim(_cfg(client_block_size=4, **kw))
+    sim = _sim(_cfg(client_block_size=4, **kw))
+    assert sim._stream_defense == method
+    state, _ = _run(sim, 1)
+    for leaf in jax.tree.leaves(state.variables):
+        assert np.all(np.isfinite(np.asarray(leaf)))
 
 
-def test_bulk_rejects_gauss_adversary():
+def test_bulk_gauss_adversary_parity():
+    """The gauss draw keys per ROW on (round, client id), so the bulk
+    per-block application is independent of the chunking — the same
+    ulp band vs the stacked path as every other adversary mode."""
     adv = AdversaryPolicy(mode="gauss", ranks=(1,), noise_stddev=0.1)
-    with pytest.raises(ValueError, match="gauss"):
-        _sim(_cfg(adversary=adv, client_block_size=4))
+    s_ref, _ = _run(_sim(_cfg(adversary=adv)), 2)
+    s_bulk, _ = _run(_sim(_cfg(adversary=adv, client_block_size=4)), 2)
+    _assert_state_close(s_ref, s_bulk)
 
 
 def test_bulk_clip_still_composes():

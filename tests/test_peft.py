@@ -587,17 +587,30 @@ def test_personalize_no_leak_and_bank_semantics():
 
 
 @pytest.mark.parametrize("fed_kw,err", [
-    (dict(peft_personalize=True, client_block_size=2), "bulk"),
-    (dict(peft_personalize=True, elastic_buckets=True), "elastic"),
     (dict(peft_personalize=True, compress="int8"), "compress"),
-    (dict(peft_personalize=True, fuse_rounds=2), "fuse_rounds"),
     (dict(peft_personalize=True, robust_method="krum"),
      "robust_method"),
     (dict(peft="none", peft_personalize=True), "peft_personalize"),
 ])
 def test_personalize_rejection_table(fed_kw, err):
+    # bulk / elastic / fuse_rounds now COMPOSE with personalization
+    # (the adapter bank threads the scan carry — tests/test_statebank.py);
+    # compress and defended robust_method remain loud rejections.
     with pytest.raises(ValueError, match=err):
         _sim(_cfg(**fed_kw))
+
+
+@pytest.mark.parametrize("fed_kw", [
+    dict(peft_personalize=True, client_block_size=2),
+    dict(peft_personalize=True, elastic_buckets=True),
+    dict(peft_personalize=True, fuse_rounds=2),
+])
+def test_personalize_composition_accepted(fed_kw):
+    sim = _sim(_cfg(num_clients=8, rounds=2, cohort=4, **fed_kw))
+    state = sim.init()
+    state, m = sim.run_round(state)
+    assert np.isfinite(float(m["train_loss"]))
+    assert sim._adapter_bank is not None
 
 
 def test_personalize_bank_survives_init_snapshot():
@@ -622,21 +635,25 @@ def test_vocab_smaller_than_data_rejected():
         FedAvgSim(create_model(small.model), _data(cfg), small)
 
 
-def test_personalize_checkpoint_rejected():
-    # the private bank does not ride the round checkpoint — a resumed
-    # run would silently reset personalization, so the combo fails
-    # loudly at construction (and parse) instead
+def test_personalize_checkpoint_accepted():
+    # the private bank rides the round checkpoint as the harness's
+    # {"server", "bank"} composite now (tests/test_statebank.py pins
+    # the bitwise kill/restore), so the combo constructs AND parses
     cfg = dataclasses.replace(_cfg(peft_personalize=True),
                               checkpoint_every=5)
-    with pytest.raises(ValueError, match="checkpoint_every"):
-        _sim(cfg)
+    sim = _sim(cfg)
+    state = sim.init()
+    state, _ = sim.run_round(state)
+    assert "adapter" in sim.bank_state()
     from fedml_tpu.experiments.run import parse_args
 
-    with pytest.raises(SystemExit, match="checkpoint"):
-        parse_args(["--algorithm", "fedavg", "--dataset",
-                    "fake_stackoverflow_nwp", "--model",
-                    "transformer_lm", "--peft", "lora",
-                    "--peft_personalize", "--checkpoint_every", "5"])
+    parsed, _ = parse_args(["--algorithm", "fedavg", "--dataset",
+                            "fake_stackoverflow_nwp", "--model",
+                            "transformer_lm", "--peft", "lora",
+                            "--peft_personalize",
+                            "--checkpoint_every", "5"])
+    assert parsed.fed.peft_personalize
+    assert parsed.checkpoint_every == 5
 
 
 def test_personalize_adversary_rejected():
@@ -650,16 +667,32 @@ def test_personalize_adversary_rejected():
         _sim(cfg)
 
 
-def test_personalize_sharded_rejected():
+def test_personalize_sharded_accepted():
+    # the adapter bank shards over the client axis now — the sharded
+    # round trains it in place and the no-leak pin still holds
     from fedml_tpu.parallel import ShardedFedAvg, make_mesh
 
     cfg = dataclasses.replace(
-        _cfg(peft_personalize=True),
+        _cfg(num_clients=8, rounds=2, cohort=4,
+             peft_personalize=True),
         mesh=MeshConfig(client_axis_size=4, data_axis_size=1),
     )
-    with pytest.raises(ValueError, match="peft_personalize"):
-        ShardedFedAvg(create_model(cfg.model), _data(cfg), cfg,
-                      make_mesh(client_axis=4, data_axis=1))
+    sim = ShardedFedAvg(create_model(cfg.model), _data(cfg), cfg,
+                        make_mesh(client_axis=4, data_axis=1))
+    state = sim.init()
+    params0 = jax.device_get(state.variables["params"])
+    server_adapters0 = sim._peft.private.trainable(params0)
+    for _ in range(2):
+        state, m = sim.run_round(state)
+        assert np.isfinite(float(m["train_loss"]))
+    # no-leak: the server state's adapter leaves are bitwise init
+    _bitwise(
+        sim._peft.private.trainable(
+            jax.device_get(state.variables["params"])
+        ),
+        server_adapters0, "sharded server-side adapters",
+    )
+    assert sim._bank_adapter is not None
 
 
 def test_peft_rejects_non_transformer_sim():

@@ -1,0 +1,303 @@
+"""Streaming Byzantine defenses (core/streamdef.py,
+docs/FAULT_TOLERANCE.md "Threat model", docs/PERFORMANCE.md
+"Bulk-client execution").
+
+The contract, in tiers:
+
+1. **Sketch accuracy**: the coordinate-quantile histogram's median /
+   trimmed-mean estimates land within ONE BIN WIDTH of the exact
+   order statistics; the trim-count table is the stacked formula; the
+   seeded projection is deterministic and distance-preserving enough
+   for selection.
+2. **Selection semantics**: krum's one-hot weight excludes a planted
+   outlier; fltrust's zero-trust case degrades to a zero aggregate.
+3. **Streamed-vs-stacked parity**: each defense under
+   ``client_block_size > 0`` tracks its stacked twin within a
+   per-method band (median/trimmed: quantile-from-histogram error;
+   krum: selection may legitimately differ on clean, well-clustered
+   data; fltrust: the projected reference is a documented
+   divergence).
+4. **The recovery battery**: the PR-4 adversary scenarios — the
+   undefended streamed mean diverges, every streamed defense ends
+   within tolerance of the clean loss. Defenses actually defend at
+   O(block) memory.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.config import (
+    DataConfig,
+    ExperimentConfig,
+    FedConfig,
+    ModelConfig,
+    TrainConfig,
+)
+from fedml_tpu.core import streamdef as SD
+from fedml_tpu.core.adversary import AdversaryPolicy
+from fedml_tpu.algorithms.fedavg import FedAvgSim
+from fedml_tpu.data.loaders import load_dataset
+from fedml_tpu.models import create_model
+
+
+def _cfg(num_clients=8, rounds=2, cohort=None, adversary=None,
+         method="mean", **fed_kw):
+    cohort = num_clients if cohort is None else cohort
+    fed_kw.setdefault("eval_every", rounds)
+    kw = {"adversary": adversary} if adversary is not None else {}
+    return ExperimentConfig(
+        data=DataConfig(dataset="fake_mnist", num_clients=num_clients,
+                        batch_size=32, seed=0),
+        model=ModelConfig(name="lr", num_classes=10,
+                          input_shape=(28, 28, 1)),
+        train=TrainConfig(lr=0.1, epochs=1),
+        fed=FedConfig(num_rounds=rounds, clients_per_round=cohort,
+                      robust_method=method, **fed_kw),
+        seed=0,
+        **kw,
+    )
+
+
+def _run(cfg):
+    sim = FedAvgSim(create_model(cfg.model), load_dataset(cfg.data),
+                    cfg)
+    state = sim.init()
+    m = {}
+    for _ in range(cfg.fed.num_rounds):
+        state, m = sim.run_round(state)
+    return state, {k: float(v) for k, v in m.items()}
+
+
+def _leaves(state):
+    return [np.asarray(v) for v in jax.tree.leaves(state.variables)]
+
+
+# ---------------------------------------------------------------------------
+# 1. sketch accuracy (pure-function tier)
+# ---------------------------------------------------------------------------
+
+
+def _full_hist(flat, live):
+    """Fold the whole cohort as blocks of 2 — the scan's carry-add."""
+    mom = SD.CoordMoments(
+        sum_x=jnp.zeros(flat.shape[1]), sum_sq=jnp.zeros(flat.shape[1]),
+        count=jnp.asarray(0.0),
+    )
+    for i in range(0, flat.shape[0], 2):
+        b = SD.fold_moments(flat[i:i + 2], live[i:i + 2])
+        mom = SD.CoordMoments(mom.sum_x + b.sum_x,
+                              mom.sum_sq + b.sum_sq,
+                              mom.count + b.count)
+    lo, width = SD.hist_edges(mom)
+    hist = jnp.zeros((SD.HIST_BINS, flat.shape[1]))
+    for i in range(0, flat.shape[0], 2):
+        hist = hist + SD.fold_hist(flat[i:i + 2], live[i:i + 2],
+                                   lo, width)
+    return mom, lo, width, hist
+
+
+def test_hist_median_within_one_bin():
+    # ODD live count: the CDF crossing at count/2 lands in the bin
+    # holding THE median order statistic, so the interpolated estimate
+    # is within that bin (an even count's numpy median averages two
+    # order statistics that may straddle a bin edge)
+    rng = np.random.default_rng(0)
+    flat = jnp.asarray(rng.normal(size=(15, 7)).astype(np.float32))
+    live = jnp.ones((15,), jnp.float32)
+    mom, lo, width, hist = _full_hist(flat, live)
+    est = np.asarray(SD.median_from_hist(hist, lo, width, mom.count))
+    exact = np.median(np.asarray(flat), axis=0)
+    np.testing.assert_array_less(
+        np.abs(est - exact), np.asarray(width) + 1e-6
+    )
+
+
+def test_hist_median_exact_on_zero_spread():
+    flat = jnp.full((6, 3), 2.5, jnp.float32)
+    live = jnp.ones((6,), jnp.float32)
+    mom, lo, width, hist = _full_hist(flat, live)
+    est = np.asarray(SD.median_from_hist(hist, lo, width, mom.count))
+    np.testing.assert_allclose(est, 2.5, rtol=0, atol=1e-6)
+
+
+def test_hist_trimmed_mean_within_one_bin():
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(10, 5)).astype(np.float32)
+    x[0] *= 40.0  # one outlier row the trim band must drop
+    flat = jnp.asarray(x)
+    live = jnp.ones((10,), jnp.float32)
+    mom, lo, width, hist = _full_hist(flat, live)
+    ks = SD.trim_table(0.2, 16)
+    est = np.asarray(SD.trimmed_mean_from_hist(hist, lo, width,
+                                               mom.count, ks))
+    # exact stacked rule: drop k=2 per side, mean the rank band
+    srt = np.sort(x, axis=0)[2:-2]
+    exact = srt.mean(axis=0)
+    np.testing.assert_array_less(
+        np.abs(est - exact), np.asarray(width) + 1e-6
+    )
+
+
+def test_trim_table_matches_stacked_formula():
+    ks = np.asarray(SD.trim_table(0.3, 12))
+    for c in range(13):
+        assert ks[c] == max(0, min(int(c * 0.3), (c - 1) // 2))
+
+
+def test_projection_deterministic_and_distance_preserving():
+    rng = np.random.default_rng(2)
+    rows = {"w": jnp.asarray(rng.normal(size=(6, 40)).astype(np.float32))}
+    rkey = jax.random.PRNGKey(7)
+    p1 = np.asarray(SD.project_rows(rows, rkey))
+    p2 = np.asarray(SD.project_rows(rows, rkey))
+    np.testing.assert_array_equal(p1, p2)  # seeded, never stored
+    assert p1.shape == (6, SD.PROJ_DIM)
+    # JL at P=256: squared distances preserved within ~50% — enough
+    # to order a 40x outlier against an O(1) cluster
+    a = np.asarray(rows["w"])
+    for i, j in [(0, 1), (2, 5)]:
+        d_true = np.sum((a[i] - a[j]) ** 2)
+        d_proj = np.sum((p1[i] - p1[j]) ** 2)
+        assert 0.5 * d_true < d_proj < 1.5 * d_true
+
+
+def test_krum_weights_exclude_planted_outlier():
+    rng = np.random.default_rng(3)
+    proj = rng.normal(size=(8, SD.PROJ_DIM)).astype(np.float32) * 0.01
+    proj[3] += 50.0  # the Byzantine row
+    sk = SD.ProjSketch(
+        proj=jnp.asarray(proj),
+        norm=jnp.ones((8,), jnp.float32),
+        weight=jnp.ones((8,), jnp.float32),
+        live=jnp.ones((8,), jnp.float32),
+    )
+    w, den = SD.selection_weights("krum", sk, 1, 0)
+    w = np.asarray(w)
+    assert w[3] == 0.0 and w.sum() == 1.0  # one-hot, not the outlier
+    wm, dm = SD.selection_weights("multikrum", sk, 1, 0)
+    assert np.asarray(wm)[3] == 0.0
+    assert float(dm) > 0
+
+
+def test_fltrust_untrusted_rows_and_zero_trust_degrade():
+    # reference = coordinate median of the rows; the anti-aligned
+    # outlier earns relu(cos) = 0 trust, the aligned cluster shares it
+    proj = np.zeros((4, SD.PROJ_DIM), np.float32)
+    proj[:, 0] = [1.0, 1.0, 1.0, -30.0]
+    sk = SD.ProjSketch(proj=jnp.asarray(proj),
+                       norm=jnp.ones((4,), jnp.float32),
+                       weight=jnp.ones((4,), jnp.float32),
+                       live=jnp.ones((4,), jnp.float32))
+    w, den = SD.selection_weights("fltrust", sk, 0, 0)
+    w = np.asarray(w)
+    assert w[3] == 0.0  # relu(cos) kills the anti-aligned row
+    assert np.all(w[:3] > 0)
+    # two exactly opposite rows: the median reference is zero, total
+    # trust is zero, and the rule degrades to a ZERO aggregate
+    # (documented in selection_weights) instead of dividing by zero
+    proj2 = np.zeros((2, SD.PROJ_DIM), np.float32)
+    proj2[:, 0] = [5.0, -5.0]
+    sk2 = SD.ProjSketch(proj=jnp.asarray(proj2),
+                        norm=jnp.ones((2,), jnp.float32),
+                        weight=jnp.ones((2,), jnp.float32),
+                        live=jnp.ones((2,), jnp.float32))
+    w2, _ = SD.selection_weights("fltrust", sk2, 0, 0)
+    np.testing.assert_array_equal(np.asarray(w2), 0.0)
+
+
+def test_sketch_mb_is_o_sketch():
+    d = 10**8  # a 100M-parameter wire
+    for meth in SD.STREAM_METHODS:
+        mb = SD.sketch_mb(meth, d, 1024)
+        if meth in SD.QUANTILE_METHODS:
+            # histogram carries scale with D (bins x D), not with C
+            assert mb < 4.0 * (SD.HIST_BINS + 3) * d / 1e6 + 1.0
+        else:
+            # projection carries scale with slots x P, independent of D
+            assert mb < 4.0 * 1024 * (SD.PROJ_DIM + 3) / 1e6 + 1.0
+
+
+# ---------------------------------------------------------------------------
+# 3. streamed-vs-stacked parity bands
+# ---------------------------------------------------------------------------
+
+# measured max|delta params| on this config (2 rounds, lr/fake_mnist):
+# median 1.2e-2 (one bin width), trimmed 2.6e-4, krum 5.6e-2 (clean-
+# data selection ties), multikrum 6.9e-3, fltrust 3.4e-3 (projected
+# reference divergence, documented in selection_weights)
+_PARITY_BAND = {
+    "median": 8e-2,
+    "trimmed_mean": 5e-3,
+    "krum": 2.5e-1,
+    "multikrum": 5e-2,
+    "fltrust": 5e-2,
+}
+
+
+@pytest.mark.parametrize("method", sorted(_PARITY_BAND))
+def test_streamed_defense_tracks_stacked(method):
+    kw = {}
+    if method in ("krum", "multikrum"):
+        kw["robust_num_adversaries"] = 1
+    s_bulk, m_bulk = _run(_cfg(method=method, client_block_size=2,
+                               **kw))
+    s_stk, m_stk = _run(_cfg(method=method, **kw))
+    assert np.isfinite(m_bulk["train_loss"])
+    diff = max(
+        np.max(np.abs(a - b))
+        for a, b in zip(_leaves(s_bulk), _leaves(s_stk))
+    )
+    assert diff < _PARITY_BAND[method], (method, diff)
+
+
+# ---------------------------------------------------------------------------
+# 4. the recovery battery (the PR-4 pins, streamed)
+# ---------------------------------------------------------------------------
+
+_SCENARIOS = {
+    # 1 of 4 clients sign-flips its delta, boosted 10x
+    "signflip_1of4": (4, AdversaryPolicy(mode="sign_flip", ranks=(0,),
+                                         scale=10.0)),
+    # 2 of 8 clients collude on a shared 10x-scaled steering direction
+    "collude_2of8": (8, AdversaryPolicy(mode="collude", ranks=(1, 5),
+                                        scale=10.0)),
+}
+# undefended-vs-clean divergence floor per scenario: the sign-flip
+# blows the loss up by orders of magnitude; the colluding pair steers
+# more quietly but measurably
+_DIVERGE_FLOOR = {"signflip_1of4": 1.0, "collude_2of8": 0.01}
+_CLEAN_LOSS: dict[str, float] = {}
+_ATTACKED_LOSS: dict[str, float] = {}
+
+
+def _scenario_losses(name):
+    nc, adv = _SCENARIOS[name]
+    if name not in _CLEAN_LOSS:
+        _, m = _run(_cfg(num_clients=nc, rounds=6))
+        _CLEAN_LOSS[name] = m["train_loss"]
+        _, m = _run(_cfg(num_clients=nc, rounds=6, adversary=adv))
+        _ATTACKED_LOSS[name] = m["train_loss"]
+    return _CLEAN_LOSS[name], _ATTACKED_LOSS[name]
+
+
+@pytest.mark.parametrize("scenario", sorted(_SCENARIOS))
+@pytest.mark.parametrize("defense", ["median", "trimmed_mean", "krum",
+                                     "multikrum", "fltrust"])
+def test_streamed_defense_recovers_under_attack(scenario, defense):
+    nc, adv = _SCENARIOS[scenario]
+    clean, attacked = _scenario_losses(scenario)
+    assert attacked > clean + _DIVERGE_FLOOR[scenario], (
+        "undefended mean did not diverge — the battery is vacuous"
+    )
+    kw = dict(method=defense, robust_num_adversaries=len(adv.ranks))
+    if defense == "trimmed_mean":
+        # int(0.1 * 4) == 0: the default trim fraction trims NOTHING
+        # at C=4 — the battery uses the fraction that covers f
+        kw["robust_trim_frac"] = 0.3
+    _, m = _run(_cfg(num_clients=nc, rounds=6, adversary=adv,
+                     client_block_size=2, **kw))
+    assert m["train_loss"] < clean + 0.05, (
+        scenario, defense, m["train_loss"], clean
+    )
